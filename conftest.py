@@ -1,0 +1,16 @@
+"""Root conftest: configure JAX for CPU-mesh testing BEFORE jax initializes.
+
+The reference tests "distributed" code via Ray local mode (reference
+tests/conftest.py:24-40); our analog is a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

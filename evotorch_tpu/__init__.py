@@ -1,0 +1,13 @@
+"""evotorch_tpu: a TPU-native (JAX/XLA/pjit/shard_map) evolutionary
+computation framework with the capabilities of EvoTorch (nnaisense/evotorch).
+
+Design stance (SURVEY.md §7): the pure-functional ask/tell layer is the core —
+pytree states, ``jit``/``vmap``/``shard_map`` everywhere — and thin stateful
+wrappers reproduce the reference's OO ergonomics (Problem / SearchAlgorithm /
+status / loggers) on top. Ray actors are replaced by SPMD over the device mesh.
+"""
+
+from . import decorators, tools
+from .decorators import expects_ndim, on_aux_device, on_device, pass_info, rowwise, vectorized
+
+__version__ = "0.1.0"

@@ -1,0 +1,159 @@
+"""Decorators: the auto-vmap engine and fitness-function markers.
+
+Parity: reference ``decorators.py`` — ``@vectorized`` (``decorators.py:549``),
+``@expects_ndim`` (``decorators.py:613-874``), ``@rowwise``
+(``decorators.py:877-965``), ``@pass_info`` (``decorators.py:170``),
+``@on_device/@on_aux_device`` (``decorators.py:211-546``).
+
+Where the reference fakes batchability with nested ``torch.func.vmap`` wraps,
+JAX gives it natively: ``expects_ndim`` here broadcasts every declared arg to a
+common batch shape and applies one ``jax.vmap`` over a flattened batch axis.
+Device-placement decorators are retained as *markers* only — on TPU, placement
+is controlled by shardings (``jax.sharding``), not per-function device moves.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "vectorized",
+    "expects_ndim",
+    "rowwise",
+    "pass_info",
+    "on_device",
+    "on_aux_device",
+]
+
+
+def vectorized(fn: Callable) -> Callable:
+    """Mark a fitness function as operating on a whole ``(N, L)`` population
+    (reference ``decorators.py:549-610``)."""
+    fn.__evotorch_vectorized__ = True
+    return fn
+
+
+def pass_info(fn: Callable) -> Callable:
+    """Mark a network factory as wanting problem info kwargs such as
+    ``obs_length``/``act_length`` (reference ``decorators.py:170-208``)."""
+    fn.__evotorch_pass_info__ = True
+    return fn
+
+
+def on_device(device: Any) -> Callable:
+    """Marker-only parity shim for the reference's device-placement decorators
+    (``decorators.py:211-546``). The returned decorator records the requested
+    device; the TPU build controls placement via shardings instead."""
+
+    def decorator(fn: Callable) -> Callable:
+        fn.__evotorch_on_device__ = device
+        return fn
+
+    return decorator
+
+
+def on_aux_device(fn: Optional[Callable] = None):
+    if fn is None:
+        return on_device("aux")
+    return on_device("aux")(fn)
+
+
+def _tree_first_leaf(x):
+    leaves = jax.tree_util.tree_leaves(x)
+    return leaves[0] if leaves else None
+
+
+def expects_ndim(
+    *expected_ndims: Optional[int],
+    allow_smaller_ndim: bool = False,
+):
+    """Declare per-positional-arg expected core ndims; extra leading dims are
+    treated as batch dims and vmapped over (reference ``decorators.py:613-874``).
+
+    ``None`` marks an argument as static (passed through untouched). Batch
+    shapes of different args broadcast together, so e.g. a ``(B, L)`` center
+    and a scalar stdev batch cleanly — the basis of *batched searches*
+    (SURVEY.md §1, parallel API style 2).
+    """
+
+    def decorator(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            if len(args) > len(expected_ndims):
+                raise TypeError(
+                    f"{fn.__name__}: got {len(args)} positional args, but "
+                    f"expects_ndim declares only {len(expected_ndims)}"
+                )
+            arrs = []
+            batch_shapes = []
+            for arg, nd in zip(args, expected_ndims):
+                if nd is None:
+                    arrs.append(arg)
+                    continue
+                arr = jnp.asarray(arg)
+                extra = arr.ndim - nd
+                if extra < 0:
+                    if allow_smaller_ndim:
+                        arrs.append(arr)
+                        continue
+                    raise ValueError(
+                        f"{fn.__name__}: argument with shape {arr.shape} has fewer "
+                        f"than the expected {nd} dimensions"
+                    )
+                batch_shapes.append(arr.shape[:extra])
+                arrs.append(arr)
+
+            batch_shape = ()
+            for bs in batch_shapes:
+                batch_shape = jnp.broadcast_shapes(batch_shape, bs)
+
+            if batch_shape == ():
+                return fn(*arrs, **kwargs)
+
+            batch_size = math.prod(batch_shape)
+            flat_args = []
+            in_axes = []
+            for arg, nd in zip(arrs, expected_ndims):
+                if nd is None or not hasattr(arg, "ndim"):
+                    flat_args.append(arg)
+                    in_axes.append(None)
+                    continue
+                extra = arg.ndim - nd
+                if extra < 0:
+                    flat_args.append(arg)
+                    in_axes.append(None)
+                    continue
+                core_shape = arg.shape[extra:]
+                full = jnp.broadcast_to(arg, batch_shape + core_shape)
+                flat_args.append(full.reshape((batch_size,) + core_shape))
+                in_axes.append(0)
+
+            vfn = jax.vmap(
+                functools.partial(fn, **kwargs) if kwargs else fn,
+                in_axes=in_axes,
+            )
+            out = vfn(*flat_args)
+            return jax.tree_util.tree_map(
+                lambda leaf: leaf.reshape(batch_shape + leaf.shape[1:]), out
+            )
+
+        wrapped.__expects_ndim__ = expected_ndims
+        return wrapped
+
+    return decorator
+
+
+def rowwise(fn: Callable) -> Callable:
+    """Wrap a function written for a single 1-D row so it accepts any number of
+    leading batch dims (reference ``decorators.py:877-965``). The wrapped
+    function is also marked ``@vectorized`` since it can consume an ``(N, L)``
+    population directly."""
+    wrapped = expects_ndim(1)(fn)
+    wrapped.__evotorch_rowwise__ = True
+    wrapped.__evotorch_vectorized__ = True
+    return wrapped

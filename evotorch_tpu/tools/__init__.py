@@ -1,0 +1,87 @@
+"""Tools layer (L1): pure data-structure and kernel utilities.
+
+Parity target: reference ``src/evotorch/tools/`` (SURVEY.md §2.8).
+"""
+
+from . import cloning, constraints, hook, immutable, misc, objectarray, ranking
+from .cloning import Clonable, ReadOnlyClonable, Serializable, deep_clone
+from .constraints import log_barrier, penalty, violation
+from .hook import Hook
+from .immutable import (
+    ImmutableContainer,
+    ImmutableDict,
+    ImmutableList,
+    ImmutableSet,
+    as_immutable,
+    is_immutable,
+    mutable_copy,
+)
+from .misc import (
+    Device,
+    DType,
+    ErroneousResult,
+    cast_arrays_in_container,
+    clip_tensor,
+    clone,
+    dtype_of_container,
+    ensure_array_length_and_dtype,
+    is_dtype_bool,
+    is_dtype_float,
+    is_dtype_integer,
+    is_dtype_object,
+    is_dtype_real,
+    modify_tensor,
+    modify_vector,
+    split_workload,
+    stdev_from_radius,
+    to_jax_dtype,
+    to_numpy_dtype,
+    to_stdev_init,
+)
+from .objectarray import ObjectArray
+from .ranking import rank, rankers
+from .recursiveprintable import RecursivePrintable
+from .tensormaker import TensorMakerMixin
+
+__all__ = [
+    "Clonable",
+    "ReadOnlyClonable",
+    "Serializable",
+    "deep_clone",
+    "log_barrier",
+    "penalty",
+    "violation",
+    "Hook",
+    "ImmutableContainer",
+    "ImmutableDict",
+    "ImmutableList",
+    "ImmutableSet",
+    "as_immutable",
+    "is_immutable",
+    "mutable_copy",
+    "Device",
+    "DType",
+    "ErroneousResult",
+    "cast_arrays_in_container",
+    "clip_tensor",
+    "clone",
+    "dtype_of_container",
+    "ensure_array_length_and_dtype",
+    "is_dtype_bool",
+    "is_dtype_float",
+    "is_dtype_integer",
+    "is_dtype_object",
+    "is_dtype_real",
+    "modify_tensor",
+    "modify_vector",
+    "split_workload",
+    "stdev_from_radius",
+    "to_jax_dtype",
+    "to_numpy_dtype",
+    "to_stdev_init",
+    "ObjectArray",
+    "rank",
+    "rankers",
+    "RecursivePrintable",
+    "TensorMakerMixin",
+]

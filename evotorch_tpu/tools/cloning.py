@@ -1,0 +1,113 @@
+"""Cloning and serialization discipline.
+
+Parity: reference ``tools/cloning.py`` (``deep_clone`` ``cloning.py:25``,
+``Clonable/Serializable/ReadOnlyClonable`` ``cloning.py:203-340``). JAX arrays
+are immutable, so cloning them is the identity; the machinery below exists for
+host-side state (numpy arrays, dicts, object-dtype payloads) and to give every
+core object a pickle-based checkpoint path (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["deep_clone", "Clonable", "Serializable", "ReadOnlyClonable"]
+
+
+def deep_clone(
+    x: Any,
+    *,
+    otherwise_deepcopy: bool = True,
+    memo: Optional[dict] = None,
+) -> Any:
+    """Deep-clone ``x``. jax.Arrays are returned as-is (immutable); numpy
+    arrays are copied; ``Clonable`` objects delegate to their ``clone``;
+    containers recurse with memoization (reference ``cloning.py:25``)."""
+    if memo is None:
+        memo = {}
+    key = id(x)
+    if key in memo:
+        return memo[key]
+
+    if isinstance(x, jax.Array):
+        result = x
+    elif isinstance(x, np.ndarray):
+        result = x.copy()
+    elif isinstance(x, Clonable):
+        result = x.clone(memo=memo)
+    elif isinstance(x, dict):
+        result = type(x)()
+        memo[key] = result
+        for k, v in x.items():
+            result[deep_clone(k, memo=memo)] = deep_clone(v, memo=memo)
+        return result
+    elif isinstance(x, list):
+        result = type(x)()
+        memo[key] = result
+        for v in x:
+            result.append(deep_clone(v, memo=memo))
+        return result
+    elif isinstance(x, tuple):
+        cloned = [deep_clone(v, memo=memo) for v in x]
+        result = tuple(cloned) if type(x) is tuple else type(x)(*cloned)
+    elif isinstance(x, set):
+        result = {deep_clone(v, memo=memo) for v in x}
+    elif isinstance(x, (int, float, complex, str, bytes, bool, type(None))):
+        result = x
+    elif otherwise_deepcopy:
+        result = copy.deepcopy(x, memo)
+    else:
+        result = x
+    memo[key] = result
+    return result
+
+
+class Clonable:
+    """Objects that know how to clone themselves (reference ``cloning.py:203``)."""
+
+    def _get_cloned_state(self, *, memo: dict) -> dict:
+        return {k: deep_clone(v, memo=memo) for k, v in self.__dict__.items()}
+
+    def clone(self, *, memo: Optional[dict] = None) -> "Clonable":
+        if memo is None:
+            memo = {}
+        if id(self) in memo:
+            return memo[id(self)]
+        new = object.__new__(type(self))
+        memo[id(self)] = new
+        new.__dict__.update(self._get_cloned_state(memo=memo))
+        return new
+
+    def __copy__(self):
+        return self.clone()
+
+    def __deepcopy__(self, memo):
+        return self.clone(memo=memo)
+
+
+class Serializable(Clonable):
+    """Clonable + pickling via cloned state (reference ``cloning.py:258``)."""
+
+    def __getstate__(self) -> dict:
+        return self._get_cloned_state(memo={id(self): self})
+
+    def __setstate__(self, state: dict):
+        self.__dict__.update(state)
+
+
+class ReadOnlyClonable(Clonable):
+    """Clonable whose default clone is a *mutable* copy of read-only data
+    (reference ``cloning.py:300``). Subclasses implement
+    ``_get_mutable_clone``."""
+
+    def clone(self, *, memo: Optional[dict] = None, preserve_read_only: bool = False):
+        if preserve_read_only:
+            return super().clone(memo=memo)
+        return self._get_mutable_clone(memo=memo if memo is not None else {})
+
+    def _get_mutable_clone(self, *, memo: dict):
+        raise NotImplementedError

@@ -1,0 +1,79 @@
+"""Batched constraint penalization kernels.
+
+Parity: reference ``tools/constraints.py:22-281`` (``violation``,
+``log_barrier``, ``penalty``), written row-wise and auto-batched with
+``expects_ndim`` — extra leading dims on any argument vmap transparently.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..decorators import expects_ndim
+
+__all__ = ["violation", "log_barrier", "penalty"]
+
+_COMPARISONS = ("<=", ">=", "==")
+
+
+def _check_comparison(comparison: str):
+    if comparison not in _COMPARISONS:
+        raise ValueError(f"comparison must be one of {_COMPARISONS}, got {comparison!r}")
+
+
+@expects_ndim(0, None, 0)
+def _violation(lhs, comparison, rhs):
+    if comparison == "<=":
+        return jnp.maximum(lhs - rhs, 0.0)
+    if comparison == ">=":
+        return jnp.maximum(rhs - lhs, 0.0)
+    return jnp.abs(lhs - rhs)
+
+
+def violation(lhs, comparison: str, rhs):
+    """Amount by which ``lhs <comparison> rhs`` is violated; 0 when satisfied
+    (reference ``constraints.py:22``)."""
+    _check_comparison(comparison)
+    return _violation(lhs, comparison, rhs)
+
+
+@expects_ndim(0, None, 0, 0)
+def _log_barrier(lhs, comparison, rhs, sharpness):
+    if comparison == "<=":
+        gap = rhs - lhs
+    else:
+        gap = lhs - rhs
+    penalty_val = jnp.where(gap > 0, jnp.log(jnp.maximum(gap, 1e-30)) / sharpness, -jnp.inf)
+    return jnp.minimum(penalty_val, 0.0)
+
+def log_barrier(lhs, comparison: str, rhs, *, sharpness=1.0):
+    """Logarithmic barrier penalty: 0-ish while well inside the feasible
+    region, → -inf as the boundary is approached/crossed (reference
+    ``constraints.py:108``). Returned values are <= 0; add to a fitness that
+    is being maximized (negate for minimization)."""
+    if comparison not in ("<=", ">="):
+        raise ValueError(
+            f"log_barrier requires an inequality comparison, got {comparison!r}"
+        )
+    return _log_barrier(lhs, comparison, rhs, sharpness)
+
+
+@expects_ndim(0, None, 0, 0, 0)
+def _penalty(lhs, comparison, rhs, linear, step):
+    v = _violation.__wrapped__(lhs, comparison, rhs)
+    result = -(linear * v)
+    result = result - jnp.where(v > 0, step, 0.0)
+    return result
+
+
+def penalty(lhs, comparison: str, rhs, *, penalty_sign: str = "-", linear=1.0, step=0.0):
+    """Linear + step penalty for a violated constraint (reference
+    ``constraints.py:190``). ``penalty_sign='-'`` produces values <= 0 (for
+    maximization problems); ``'+'`` produces values >= 0 (for minimization)."""
+    _check_comparison(comparison)
+    if penalty_sign not in ("+", "-"):
+        raise ValueError(f"penalty_sign must be '+' or '-', got {penalty_sign!r}")
+    result = _penalty(lhs, comparison, rhs, linear, step)
+    if penalty_sign == "+":
+        result = -result
+    return result

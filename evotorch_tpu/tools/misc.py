@@ -1,0 +1,312 @@
+"""General-purpose tool kernels and helpers.
+
+Parity with the subset of the reference's ``tools/misc.py`` that matters on
+TPU: dtype/device coercion (``misc.py:75-118``), bounded updates
+``modify_tensor``/``modify_vector`` (``misc.py:711-909``), workload splitting
+(``misc.py:1113``), radius→stdev (``misc.py:1879-1925``) and an
+``ErroneousResult`` marker (``misc.py:1006``). Tensor factories live in
+``tensormaker.py``; torch ``Generator`` plumbing is replaced by explicit JAX
+PRNG keys throughout the package.
+"""
+
+from __future__ import annotations
+
+import math
+from numbers import Number
+from typing import Any, Iterable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Device",
+    "DType",
+    "to_jax_dtype",
+    "to_numpy_dtype",
+    "is_dtype_object",
+    "is_dtype_bool",
+    "is_dtype_integer",
+    "is_dtype_float",
+    "is_dtype_real",
+    "cast_arrays_in_container",
+    "dtype_of_container",
+    "clone",
+    "ensure_array_length_and_dtype",
+    "modify_tensor",
+    "modify_vector",
+    "clip_tensor",
+    "split_workload",
+    "stdev_from_radius",
+    "to_stdev_init",
+    "ErroneousResult",
+    "pass_through",
+    "expect_none",
+    "message_from",
+]
+
+Device = Any
+DType = Any
+
+_DTYPE_ALIASES = {
+    "float": jnp.float32,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "int": jnp.int32,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "int16": jnp.int16,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "bool": jnp.bool_,
+}
+
+
+def to_jax_dtype(dtype: DType):
+    """Coerce a dtype-like (str, np dtype, jnp dtype, ``object``) to a jnp dtype.
+
+    ``object`` is passed through unchanged: object-typed problems live host-side
+    (reference ``tools/misc.py:118`` ``is_dtype_object``).
+    """
+    if dtype is object or dtype == "object":
+        return object
+    if isinstance(dtype, str):
+        key = dtype.replace("torch.", "").replace("jnp.", "")
+        if key in _DTYPE_ALIASES:
+            return _DTYPE_ALIASES[key]
+    return jnp.dtype(dtype)
+
+
+def to_numpy_dtype(dtype: DType):
+    d = to_jax_dtype(dtype)
+    if d is object:
+        return np.dtype(object)
+    return np.dtype(d)
+
+
+def is_dtype_object(dtype: DType) -> bool:
+    return to_jax_dtype(dtype) is object
+
+
+def is_dtype_bool(dtype: DType) -> bool:
+    d = to_jax_dtype(dtype)
+    return d is not object and jnp.issubdtype(d, jnp.bool_)
+
+
+def is_dtype_integer(dtype: DType) -> bool:
+    d = to_jax_dtype(dtype)
+    return d is not object and jnp.issubdtype(d, jnp.integer)
+
+
+def is_dtype_float(dtype: DType) -> bool:
+    d = to_jax_dtype(dtype)
+    return d is not object and jnp.issubdtype(d, jnp.floating)
+
+
+def is_dtype_real(dtype: DType) -> bool:
+    return is_dtype_float(dtype) or is_dtype_integer(dtype)
+
+
+def cast_arrays_in_container(container: Any, *, dtype: Optional[DType] = None) -> Any:
+    """Cast every array leaf of a pytree to ``dtype`` (reference
+    ``misc.py:347`` ``cast_tensors_in_container``; device moves are not needed —
+    placement is controlled by shardings in JAX)."""
+    if dtype is None:
+        return container
+    d = to_jax_dtype(dtype)
+
+    def cast(leaf):
+        if isinstance(leaf, (jnp.ndarray, jax.Array, np.ndarray)):
+            return jnp.asarray(leaf, dtype=d)
+        return leaf
+
+    return jax.tree_util.tree_map(cast, container)
+
+
+def dtype_of_container(container: Any):
+    """Common dtype of the array leaves of a pytree (reference ``misc.py:422``)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(container) if hasattr(l, "dtype")]
+    if not leaves:
+        return None
+    dtypes = {np.dtype(l.dtype) for l in leaves}
+    if len(dtypes) > 1:
+        raise ValueError(f"Container has multiple dtypes: {dtypes}")
+    return leaves[0].dtype
+
+
+def clone(x: Any, *, memo: Optional[dict] = None) -> Any:
+    """Clone a value (reference ``misc.py:588``). JAX arrays are immutable, so
+    they are returned as-is; numpy arrays and containers are deep-copied via
+    ``tools.cloning.deep_clone``."""
+    from .cloning import deep_clone
+
+    return deep_clone(x, memo=memo)
+
+
+def ensure_array_length_and_dtype(
+    x: Any,
+    length: int,
+    dtype: DType,
+    *,
+    about: Optional[str] = None,
+    allow_scalar: bool = True,
+) -> jnp.ndarray:
+    """Coerce ``x`` to a 1-D array of ``length`` with ``dtype``; scalars are
+    broadcast (reference ``misc.py:610`` ``ensure_tensor_length_and_dtype``).
+    For ``dtype=object`` the result is a host-side :class:`ObjectArray`."""
+    d = to_jax_dtype(dtype)
+    if d is object:
+        from .objectarray import ObjectArray
+
+        if isinstance(x, ObjectArray):
+            if len(x) != length:
+                raise ValueError(
+                    f"{about or 'value'}: expected length {length}, got {len(x)}"
+                )
+            return x
+        values = list(x) if not isinstance(x, (str, bytes)) else [x]
+        if len(values) == 1 and length != 1 and allow_scalar:
+            values = values * length
+        if len(values) != length:
+            raise ValueError(
+                f"{about or 'value'}: expected length {length}, got {len(values)}"
+            )
+        return ObjectArray.from_values(values)
+    if isinstance(x, Number):
+        if not allow_scalar:
+            raise ValueError(f"{about or 'value'}: expected a sequence, got scalar {x}")
+        return jnp.full((length,), x, dtype=d)
+    arr = jnp.asarray(x, dtype=d)
+    if arr.ndim == 0:
+        return jnp.full((length,), arr, dtype=d)
+    if arr.ndim != 1 or arr.shape[0] != length:
+        raise ValueError(
+            f"{about or 'value'}: expected shape ({length},), got {tuple(arr.shape)}"
+        )
+    return arr
+
+
+def _as_opt_array(x):
+    return None if x is None else jnp.asarray(x)
+
+
+def modify_tensor(
+    original: jnp.ndarray,
+    target: jnp.ndarray,
+    lb: Optional[Union[float, jnp.ndarray]] = None,
+    ub: Optional[Union[float, jnp.ndarray]] = None,
+    max_change: Optional[Union[float, jnp.ndarray]] = None,
+    *,
+    in_place: bool = False,  # accepted for API parity; arrays are immutable
+) -> jnp.ndarray:
+    """Move ``original`` towards ``target`` subject to bounds.
+
+    ``max_change`` limits the per-element change relative to
+    ``|original|`` (e.g. ``0.2`` allows a 20% change — the reference's
+    controlled-stdev-update mechanism, ``misc.py:711-909`` /
+    ``gaussian.py:369-419``); ``lb``/``ub`` are absolute clamps.
+    """
+    original = jnp.asarray(original)
+    target = jnp.asarray(target, dtype=original.dtype)
+    result = target
+    if max_change is not None:
+        allowed = jnp.abs(original) * jnp.asarray(max_change, dtype=original.dtype)
+        result = original + jnp.clip(target - original, -allowed, allowed)
+    lb = _as_opt_array(lb)
+    ub = _as_opt_array(ub)
+    if lb is not None:
+        result = jnp.maximum(result, lb)
+    if ub is not None:
+        result = jnp.minimum(result, ub)
+    return result
+
+
+def modify_vector(
+    original: jnp.ndarray,
+    target: jnp.ndarray,
+    lb=None,
+    ub=None,
+    max_change=None,
+) -> jnp.ndarray:
+    """1-D counterpart of :func:`modify_tensor` (reference ``misc.py:880``)."""
+    return modify_tensor(original, target, lb=lb, ub=ub, max_change=max_change)
+
+
+def clip_tensor(
+    x: jnp.ndarray,
+    lb: Optional[Union[float, jnp.ndarray]] = None,
+    ub: Optional[Union[float, jnp.ndarray]] = None,
+) -> jnp.ndarray:
+    x = jnp.asarray(x)
+    if lb is not None:
+        x = jnp.maximum(x, jnp.asarray(lb, dtype=x.dtype))
+    if ub is not None:
+        x = jnp.minimum(x, jnp.asarray(ub, dtype=x.dtype))
+    return x
+
+
+def split_workload(workload: int, num_pieces: int) -> List[int]:
+    """Split ``workload`` items into ``num_pieces`` near-equal pieces
+    (reference ``misc.py:1113``)."""
+    base = workload // num_pieces
+    rem = workload % num_pieces
+    return [base + (1 if i < rem else 0) for i in range(num_pieces)]
+
+
+def stdev_from_radius(radius: float, solution_length: int) -> float:
+    """Initial stdev from a hypersphere radius: ``σ = radius / sqrt(n)``
+    (reference ``misc.py:1879``)."""
+    return float(radius) / math.sqrt(solution_length)
+
+
+def to_stdev_init(
+    *,
+    solution_length: int,
+    stdev_init=None,
+    radius_init=None,
+):
+    """Resolve the ``stdev_init`` / ``radius_init`` constructor pair
+    (reference ``misc.py:1925``): exactly one must be given."""
+    if (stdev_init is None) == (radius_init is None):
+        raise ValueError("Exactly one of stdev_init / radius_init must be provided")
+    if stdev_init is not None:
+        return stdev_init
+    return stdev_from_radius(float(radius_init), solution_length)
+
+
+class ErroneousResult:
+    """Value-carrying error marker (reference ``misc.py:1006-1041``)."""
+
+    def __init__(self, error: Exception):
+        self.error = error
+
+    def __bool__(self) -> bool:
+        return False
+
+    def __repr__(self) -> str:
+        return f"<ErroneousResult: {self.error!r}>"
+
+    @staticmethod
+    def call(f, *args, **kwargs):
+        try:
+            return f(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — marker deliberately captures all
+            return ErroneousResult(e)
+
+
+def pass_through(x):
+    return x
+
+
+def expect_none(msg_prefix: str, **kwargs):
+    """Raise if any given kwarg is not None (reference ``misc.py`` helper used
+    by constructors that forbid option combinations)."""
+    for k, v in kwargs.items():
+        if v is not None:
+            raise ValueError(f"{msg_prefix}: unexpected argument {k}={v!r}")
+
+
+def message_from(sender: Any, message: str) -> str:
+    return f"[{type(sender).__name__}] {message}"

@@ -1,0 +1,132 @@
+"""``ObjectArray``: 1-D container of arbitrary objects with array-like indexing.
+
+Parity: reference ``tools/objectarray.py:39-534``. Object-dtype solutions
+(variable-length genomes, trees, …) cannot live in TPU HBM; this container is
+deliberately host-side (numpy object array underneath) and enforces the same
+storage discipline as the reference: values are stored as immutable clones
+(``as_immutable``) so views can be shared safely.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from .immutable import as_immutable, mutable_copy
+
+__all__ = ["ObjectArray"]
+
+
+def _elements_equal(a, b) -> bool:
+    """Scalar equality that tolerates array-valued elements."""
+    try:
+        import jax
+
+        if isinstance(a, (np.ndarray, jax.Array)) or isinstance(b, (np.ndarray, jax.Array)):
+            return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        result = a == b
+        if isinstance(result, np.ndarray):
+            return bool(result.all())
+        return bool(result)
+    except (TypeError, ValueError):
+        return False
+
+
+class ObjectArray(Sequence):
+    dtype = object
+
+    def __init__(self, size: Optional[int] = None, *, slice_of=None):
+        if slice_of is not None:
+            source, sl = slice_of
+            if size is not None:
+                raise ValueError("Cannot give both size and slice_of")
+            if not isinstance(source, ObjectArray):
+                raise TypeError("slice_of must reference an ObjectArray")
+            self._data = source._data[sl]  # numpy view: shares storage
+            self._read_only = source._read_only
+        else:
+            if size is None:
+                size = 0
+            self._data = np.empty(int(size), dtype=object)
+            self._read_only = False
+
+    # -- factory ------------------------------------------------------------
+    @classmethod
+    def from_values(cls, values: Iterable) -> "ObjectArray":
+        values = list(values)
+        result = cls(len(values))
+        for i, v in enumerate(values):
+            result[i] = v
+        return result
+
+    # -- element access ------------------------------------------------------
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return ObjectArray(slice_of=(self, i))
+        if isinstance(i, (list, np.ndarray)) and not np.isscalar(i):
+            idx = np.asarray(i)
+            if idx.dtype == bool:
+                idx = np.nonzero(idx)[0]
+            picked = ObjectArray(len(idx))
+            picked._data[:] = self._data[idx]
+            picked._read_only = self._read_only
+            return picked
+        return self._data[int(i)]
+
+    def __setitem__(self, i, value):
+        if self._read_only:
+            raise ValueError("Cannot modify a read-only ObjectArray")
+        if isinstance(i, slice):
+            values = [as_immutable(v) for v in value]
+            indices = list(range(*i.indices(len(self._data))))
+            if len(indices) != len(values):
+                raise ValueError("Slice assignment length mismatch")
+            # assign one-by-one to avoid numpy flattening sequence values
+            for j, v in zip(indices, values):
+                self._data[j] = v
+        else:
+            self._data[int(i)] = as_immutable(value)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self._data[i]
+
+    # -- semantics -----------------------------------------------------------
+    def clone(self, *, memo: Optional[dict] = None) -> "ObjectArray":
+        result = ObjectArray(len(self))
+        for i in range(len(self)):
+            result._data[i] = mutable_copy(self._data[i])
+        return result
+
+    def get_read_only_view(self) -> "ObjectArray":
+        view = ObjectArray(slice_of=(self, slice(None)))
+        view._read_only = True
+        return view
+
+    @property
+    def is_read_only(self) -> bool:
+        return self._read_only
+
+    def numpy(self) -> np.ndarray:
+        return self._data.copy()
+
+    def __eq__(self, other):
+        if isinstance(other, ObjectArray):
+            other = list(other)
+        if isinstance(other, (list, tuple)):
+            if len(self) != len(other):
+                return np.zeros(len(self), dtype=bool)
+            return np.array(
+                [_elements_equal(a, b) for a, b in zip(list(self), other)], dtype=bool
+            )
+        return NotImplemented
+
+    __hash__ = None
+
+    def __repr__(self) -> str:
+        return f"ObjectArray({list(self._data)!r})"

@@ -1,0 +1,124 @@
+"""``TensorMakerMixin``: per-object array factories.
+
+Parity: reference ``tools/tensormaker.py:27-920`` (``make_empty``,
+``make_zeros/ones/nan/I``, ``make_uniform/gaussian/randint``) and the factory
+kernels of ``tools/misc.py:1138-1815``. Dtype and shape defaults come from the
+owning object (Problem / Distribution); torch ``Generator`` awareness becomes
+JAX PRNG-key plumbing: owners expose ``next_rng_key()`` (stateful convenience
+on the host), while purely functional call-sites pass ``key=`` explicitly.
+"""
+
+from __future__ import annotations
+
+from numbers import Number
+from typing import Iterable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .misc import to_jax_dtype
+
+__all__ = ["TensorMakerMixin"]
+
+Size = Union[int, Iterable[int]]
+
+
+def _as_shape(num_solutions: Optional[int], length: Optional[int], size: Optional[Size]) -> tuple:
+    if size is not None:
+        if isinstance(size, Number):
+            return (int(size),)
+        return tuple(int(s) for s in size)
+    shape = ()
+    if num_solutions is not None:
+        shape = shape + (int(num_solutions),)
+    if length is not None:
+        shape = shape + (int(length),)
+    return shape
+
+
+class TensorMakerMixin:
+    """Owners must provide ``dtype``/``eval_dtype`` attributes, a
+    ``solution_length`` (may be None for object-typed problems), and
+    ``next_rng_key()``."""
+
+    def _make_dtype(self, dtype=None, use_eval_dtype=False):
+        if dtype is not None:
+            return to_jax_dtype(dtype)
+        if use_eval_dtype:
+            return to_jax_dtype(getattr(self, "eval_dtype", jnp.float32))
+        return to_jax_dtype(getattr(self, "dtype", jnp.float32))
+
+    def _make_shape(self, *size: Size, num_solutions=None) -> tuple:
+        if len(size) == 1 and not isinstance(size[0], Number):
+            size = tuple(size[0])
+        if len(size) > 0:
+            shape = tuple(int(s) for s in size)
+            if num_solutions is not None:
+                shape = (int(num_solutions),) + shape
+            return shape
+        length = getattr(self, "solution_length", None)
+        return _as_shape(num_solutions, length, None)
+
+    def _make_key(self, key=None):
+        if key is not None:
+            return key
+        return self.next_rng_key()
+
+    # -- deterministic fills -------------------------------------------------
+    def make_empty(self, *size: Size, num_solutions=None, dtype=None, use_eval_dtype=False):
+        return self.make_zeros(*size, num_solutions=num_solutions, dtype=dtype, use_eval_dtype=use_eval_dtype)
+
+    def make_zeros(self, *size: Size, num_solutions=None, dtype=None, use_eval_dtype=False):
+        return jnp.zeros(self._make_shape(*size, num_solutions=num_solutions), dtype=self._make_dtype(dtype, use_eval_dtype))
+
+    def make_ones(self, *size: Size, num_solutions=None, dtype=None, use_eval_dtype=False):
+        return jnp.ones(self._make_shape(*size, num_solutions=num_solutions), dtype=self._make_dtype(dtype, use_eval_dtype))
+
+    def make_nan(self, *size: Size, num_solutions=None, dtype=None, use_eval_dtype=False):
+        return jnp.full(self._make_shape(*size, num_solutions=num_solutions), jnp.nan, dtype=self._make_dtype(dtype, use_eval_dtype))
+
+    def make_I(self, size: Optional[int] = None, dtype=None, use_eval_dtype=False):
+        if size is None:
+            size = getattr(self, "solution_length", None)
+            if size is None:
+                raise ValueError("make_I needs a size when the owner has no solution_length")
+        return jnp.eye(int(size), dtype=self._make_dtype(dtype, use_eval_dtype))
+
+    # -- random fills --------------------------------------------------------
+    def make_uniform(self, *size: Size, num_solutions=None, lb=None, ub=None, dtype=None, use_eval_dtype=False, key=None):
+        dtype = self._make_dtype(dtype, use_eval_dtype)
+        shape = self._make_shape(*size, num_solutions=num_solutions)
+        key = self._make_key(key)
+        lb = 0.0 if lb is None else lb
+        ub = 1.0 if ub is None else ub
+        lb = jnp.asarray(lb, dtype=dtype)
+        ub = jnp.asarray(ub, dtype=dtype)
+        if jnp.issubdtype(dtype, jnp.integer):
+            return jax.random.randint(key, shape, minval=lb, maxval=ub + 1, dtype=dtype)
+        return jax.random.uniform(key, shape, dtype=dtype, minval=0.0, maxval=1.0) * (ub - lb) + lb
+
+    def make_gaussian(self, *size: Size, num_solutions=None, center=None, stdev=None, symmetric=False, dtype=None, use_eval_dtype=False, key=None):
+        dtype = self._make_dtype(dtype, use_eval_dtype)
+        shape = self._make_shape(*size, num_solutions=num_solutions)
+        key = self._make_key(key)
+        if symmetric:
+            if len(shape) == 0 or shape[0] % 2 != 0:
+                raise ValueError(f"symmetric gaussian requires an even leading dimension, got shape {shape}")
+            half = (shape[0] // 2,) + shape[1:]
+            eps = jax.random.normal(key, half, dtype=dtype)
+            noise = jnp.concatenate([eps, -eps], axis=0)
+        else:
+            noise = jax.random.normal(key, shape, dtype=dtype)
+        if stdev is not None:
+            noise = noise * jnp.asarray(stdev, dtype=dtype)
+        if center is not None:
+            noise = noise + jnp.asarray(center, dtype=dtype)
+        return noise
+
+    def make_randint(self, *size: Size, n: int, num_solutions=None, dtype=None, key=None):
+        dtype = self._make_dtype(dtype) if dtype is not None else jnp.int32
+        if jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.int32
+        shape = self._make_shape(*size, num_solutions=num_solutions)
+        key = self._make_key(key)
+        return jax.random.randint(key, shape, minval=0, maxval=int(n), dtype=dtype)

@@ -1,0 +1,10 @@
+"""Test-session configuration: deterministic seeds (reference tests/conftest.py:21-27)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_numpy():
+    np.random.seed(0)
+    yield

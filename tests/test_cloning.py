@@ -1,0 +1,58 @@
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from evotorch_tpu.tools import Clonable, Serializable, deep_clone
+
+
+def test_deep_clone_numpy_copies():
+    x = np.array([1.0, 2.0])
+    y = deep_clone(x)
+    y[0] = 99.0
+    assert x[0] == 1.0
+
+
+def test_deep_clone_jax_identity():
+    x = jnp.array([1.0])
+    assert deep_clone(x) is x
+
+
+def test_deep_clone_containers_with_memo():
+    inner = [1, 2]
+    x = {"a": inner, "b": inner}
+    y = deep_clone(x)
+    assert y["a"] is y["b"]
+    assert y["a"] is not inner
+
+
+class Thing(Serializable):
+    def __init__(self):
+        self.data = np.zeros(3)
+        self.name = "thing"
+
+
+def test_clonable_and_serializable():
+    t = Thing()
+    c = t.clone()
+    c.data[0] = 5.0
+    assert t.data[0] == 0.0
+    assert c.name == "thing"
+
+    p = pickle.loads(pickle.dumps(t))
+    assert isinstance(p, Thing)
+    assert p.name == "thing"
+    assert np.allclose(p.data, t.data)
+
+
+def test_recursive_clonable():
+    class Node(Clonable):
+        def __init__(self):
+            self.other = None
+
+    a = Node()
+    b = Node()
+    a.other = b
+    b.other = a
+    a2 = a.clone()
+    assert a2.other.other is a2

@@ -1,0 +1,46 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.tools import log_barrier, penalty, violation
+
+
+def test_violation_scalar():
+    assert float(violation(3.0, "<=", 5.0)) == 0.0
+    assert float(violation(7.0, "<=", 5.0)) == pytest.approx(2.0)
+    assert float(violation(3.0, ">=", 5.0)) == pytest.approx(2.0)
+    assert float(violation(7.0, ">=", 5.0)) == 0.0
+
+
+def test_violation_batched():
+    lhs = jnp.array([1.0, 6.0, 10.0])
+    out = violation(lhs, "<=", 5.0)
+    assert np.allclose(np.asarray(out), [0.0, 1.0, 5.0])
+
+
+def test_log_barrier():
+    inside = float(log_barrier(0.0, "<=", 10.0, sharpness=1.0))
+    near = float(log_barrier(9.99, "<=", 10.0, sharpness=1.0))
+    assert near < inside <= 0.0
+    crossed = float(log_barrier(11.0, "<=", 10.0, sharpness=1.0))
+    assert crossed == -np.inf
+
+
+def test_penalty_signs():
+    p = float(penalty(7.0, "<=", 5.0, penalty_sign="-", linear=2.0))
+    assert p == pytest.approx(-4.0)
+    p = float(penalty(7.0, "<=", 5.0, penalty_sign="+", linear=2.0, step=1.0))
+    assert p == pytest.approx(5.0)
+    assert float(penalty(3.0, "<=", 5.0, penalty_sign="-", linear=2.0, step=9.0)) == 0.0
+    with pytest.raises(ValueError):
+        penalty(1.0, "<=", 2.0, penalty_sign="x")
+    with pytest.raises(ValueError):
+        violation(1.0, "~=", 2.0)
+
+
+def test_equality_constraint():
+    assert float(violation(1.5, "==", 1.0)) == pytest.approx(0.5)
+    assert float(violation(1.0, "==", 1.0)) == 0.0
+    assert float(penalty(1.5, "==", 1.0, penalty_sign="-", linear=2.0)) == pytest.approx(-1.0)
+    with pytest.raises(ValueError):
+        log_barrier(1.0, "==", 2.0)

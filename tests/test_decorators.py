@@ -1,0 +1,78 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu import expects_ndim, rowwise, vectorized
+
+
+def test_vectorized_marker():
+    @vectorized
+    def f(x):
+        return x
+
+    assert f.__evotorch_vectorized__
+
+
+def test_expects_ndim_no_batch():
+    @expects_ndim(1, 1)
+    def dot(a, b):
+        return jnp.sum(a * b)
+
+    out = dot(jnp.array([1.0, 2.0]), jnp.array([3.0, 4.0]))
+    assert float(out) == pytest.approx(11.0)
+
+
+def test_expects_ndim_batched_first_arg():
+    @expects_ndim(1, 1)
+    def dot(a, b):
+        return jnp.sum(a * b)
+
+    a = jnp.array([[1.0, 2.0], [0.0, 1.0]])
+    b = jnp.array([3.0, 4.0])
+    out = dot(a, b)
+    assert out.shape == (2,)
+    assert np.allclose(np.asarray(out), [11.0, 4.0])
+
+
+def test_expects_ndim_broadcast_batches():
+    @expects_ndim(1, 0)
+    def scale(v, s):
+        return v * s
+
+    v = jnp.ones((2, 3, 4))  # batch (2, 3), core (4,)
+    s = jnp.array([1.0, 2.0, 3.0])  # batch (3,), core ()
+    out = scale(v, s)
+    assert out.shape == (2, 3, 4)
+    assert np.allclose(np.asarray(out[:, 1]), 2.0)
+
+
+def test_expects_ndim_static_arg():
+    @expects_ndim(1, None)
+    def top(v, mode):
+        assert isinstance(mode, str)
+        return jnp.max(v) if mode == "max" else jnp.min(v)
+
+    v = jnp.arange(12.0).reshape(3, 4)
+    out = top(v, "max")
+    assert out.shape == (3,)
+    assert np.allclose(np.asarray(out), [3.0, 7.0, 11.0])
+
+
+def test_expects_ndim_too_small():
+    @expects_ndim(2)
+    def f(m):
+        return jnp.sum(m)
+
+    with pytest.raises(ValueError):
+        f(jnp.ones(3))
+
+
+def test_rowwise():
+    @rowwise
+    def norm(x):
+        return jnp.sqrt(jnp.sum(x**2))
+
+    assert float(norm(jnp.array([3.0, 4.0]))) == pytest.approx(5.0)
+    batched = norm(jnp.ones((5, 4, 9)))
+    assert batched.shape == (5, 4)
+    assert norm.__evotorch_vectorized__

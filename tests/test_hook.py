@@ -1,0 +1,47 @@
+import pytest
+
+from evotorch_tpu.tools import Hook
+
+
+def test_hook_accumulates_dicts():
+    h = Hook()
+    h.append(lambda: {"a": 1})
+    h.append(lambda: {"b": 2})
+    h.append(lambda: None)
+    assert h() == {"a": 1, "b": 2}
+    assert h.accumulate_dict() == {"a": 1, "b": 2}
+
+
+def test_hook_accumulates_lists():
+    h = Hook([lambda: [1, 2], lambda: [3]])
+    assert h() == [1, 2, 3]
+    assert h.accumulate_sequence() == [1, 2, 3]
+
+
+def test_hook_mixed_results_error():
+    h = Hook([lambda: {"a": 1}, lambda: [2]])
+    with pytest.raises(TypeError):
+        h()
+
+
+def test_hook_args_kwargs_passed():
+    seen = []
+    h = Hook([lambda x, y=0: seen.append((x, y))], args=[10], kwargs={"y": 5})
+    h()
+    assert seen == [(10, 5)]
+
+
+def test_hook_is_mutable_sequence():
+    h = Hook()
+    f = lambda: None  # noqa: E731
+    h.append(f)
+    assert len(h) == 1 and h[0] is f
+    h.insert(0, f)
+    assert len(h) == 2
+    del h[0]
+    assert len(h) == 1
+
+
+def test_hook_empty_returns_none():
+    assert Hook()() is None
+    assert Hook().accumulate_dict() == {}

@@ -1,0 +1,82 @@
+import numpy as np
+import pytest
+
+from evotorch_tpu.tools import ImmutableList, ObjectArray
+
+
+def test_basic_set_get():
+    a = ObjectArray(3)
+    a[0] = [1, 2]
+    a[1] = "hello"
+    a[2] = {"k": 4}
+    assert isinstance(a[0], ImmutableList)
+    assert list(a[0]) == [1, 2]
+    assert a[1] == "hello"
+    assert a[2]["k"] == 4
+    assert len(a) == 3
+
+
+def test_slicing_shares_storage():
+    a = ObjectArray(4)
+    for i in range(4):
+        a[i] = i
+    view = a[1:3]
+    assert len(view) == 2
+    view[0] = 99
+    assert a[1] == 99
+
+
+def test_read_only_view():
+    a = ObjectArray(2)
+    a[0] = 1
+    ro = a.get_read_only_view()
+    assert ro.is_read_only
+    with pytest.raises(ValueError):
+        ro[0] = 5
+
+
+def test_clone_is_mutable_deep_copy():
+    a = ObjectArray(1)
+    a[0] = [1, 2, 3]
+    b = a.clone()
+    assert list(b[0]) == [1, 2, 3]
+    assert isinstance(b[0], list)  # mutable copy
+    b[0] = "changed"
+    assert list(a[0]) == [1, 2, 3]
+
+
+def test_fancy_indexing():
+    a = ObjectArray.from_values(["a", "b", "c", "d"])
+    picked = a[[0, 2]]
+    assert list(picked) == ["a", "c"]
+    mask = np.array([True, False, False, True])
+    picked = a[mask]
+    assert list(picked) == ["a", "d"]
+
+
+def test_slice_assignment():
+    a = ObjectArray(3)
+    a[:] = [1, 2, 3]
+    assert list(a) == [1, 2, 3]
+    with pytest.raises(ValueError):
+        a[0:2] = [1, 2, 3]
+
+
+def test_nested_objectarray():
+    from evotorch_tpu.tools import ObjectArray, as_immutable, is_immutable
+
+    outer = ObjectArray(2)
+    outer[0] = ObjectArray.from_values([1, 2])
+    assert isinstance(outer[0], ObjectArray)
+    assert outer[0].is_read_only
+    assert is_immutable(outer[0])
+    assert not is_immutable(ObjectArray(1))
+
+
+def test_eq_with_array_elements():
+    a = ObjectArray.from_values([np.array([1, 2]), 5])
+    result = a == [np.array([1, 2]), 5]
+    assert result.tolist() == [True, True]
+    result = a == [np.array([1, 3]), 5]
+    assert result.tolist() == [False, True]
+    assert (a == [1]).tolist() == [False, False]

@@ -1,0 +1,72 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.tools import ranking
+
+
+def test_centered_basic():
+    f = jnp.array([1.0, 3.0, 2.0, 4.0])
+    u = ranking.centered(f, higher_is_better=True)
+    # best solution (4.0) gets +0.5, worst (1.0) gets -0.5
+    assert np.isclose(float(u[3]), 0.5)
+    assert np.isclose(float(u[0]), -0.5)
+    assert np.isclose(float(jnp.sum(u)), 0.0, atol=1e-6)
+
+
+def test_centered_minimization():
+    f = jnp.array([1.0, 3.0, 2.0, 4.0])
+    u = ranking.centered(f, higher_is_better=False)
+    assert np.isclose(float(u[0]), 0.5)
+    assert np.isclose(float(u[3]), -0.5)
+
+
+def test_linear_range():
+    f = jnp.array([5.0, 1.0, 3.0])
+    u = ranking.linear(f, higher_is_better=True)
+    assert np.isclose(float(jnp.min(u)), 0.0)
+    assert np.isclose(float(jnp.max(u)), 1.0)
+
+
+def test_nes_properties():
+    f = jnp.array([0.1, 0.9, 0.5, 0.3, 0.7])
+    u = ranking.nes(f, higher_is_better=True)
+    # weights sum to ~0 and the best solution has the largest weight
+    assert np.isclose(float(jnp.sum(u)), 0.0, atol=1e-6)
+    assert int(jnp.argmax(u)) == int(jnp.argmax(f))
+    # worst weights are all equal to -1/n (clipped utilities)
+    assert float(u[0]) == pytest.approx(-1.0 / 5.0, abs=1e-6)
+
+
+def test_normalized():
+    f = jnp.array([1.0, 2.0, 3.0])
+    u = ranking.normalized(f, higher_is_better=True)
+    assert np.isclose(float(jnp.mean(u)), 0.0, atol=1e-6)
+    # unbiased stdev (ddof=1), matching the reference's torch.std
+    assert np.isclose(float(np.std(np.asarray(u), ddof=1)), 1.0, atol=1e-5)
+    # reference values for [3,1,2,5] (torch.std semantics)
+    u = ranking.normalized(jnp.array([3.0, 1.0, 2.0, 5.0]), higher_is_better=True)
+    assert np.allclose(np.asarray(u), [0.1462, -1.0247, -0.4392, 1.3178], atol=1e-3)
+
+
+def test_raw_sign():
+    f = jnp.array([1.0, -2.0])
+    assert np.allclose(np.asarray(ranking.raw(f, higher_is_better=True)), [1.0, -2.0])
+    assert np.allclose(np.asarray(ranking.raw(f, higher_is_better=False)), [-1.0, 2.0])
+
+
+def test_rank_dispatcher_and_batching():
+    f = jnp.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
+    u = ranking.rank(f, "centered", higher_is_better=True)
+    assert u.shape == (2, 3)
+    assert np.allclose(np.asarray(u[0]), [-0.5, 0.0, 0.5])
+    assert np.allclose(np.asarray(u[1]), [0.5, 0.0, -0.5])
+    with pytest.raises(ValueError):
+        ranking.rank(f, "bogus", higher_is_better=True)
+
+
+def test_ties_get_distinct_ranks():
+    f = jnp.array([1.0, 1.0, 1.0])
+    u = ranking.centered(f, higher_is_better=True)
+    assert np.isclose(float(jnp.sum(u)), 0.0, atol=1e-6)
+    assert len(set(np.asarray(u).tolist())) == 3

@@ -1,0 +1,138 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu import tools
+from evotorch_tpu.tools import misc
+
+
+def test_to_jax_dtype():
+    assert misc.to_jax_dtype("float32") == jnp.float32
+    assert misc.to_jax_dtype(np.float32) == jnp.float32
+    assert misc.to_jax_dtype(object) is object
+    assert misc.to_jax_dtype("bool") == jnp.bool_
+    assert misc.is_dtype_object(object)
+    assert not misc.is_dtype_object("float32")
+    assert misc.is_dtype_float("float32")
+    assert misc.is_dtype_integer("int32")
+    assert misc.is_dtype_real("int32") and misc.is_dtype_real("float32")
+    assert misc.is_dtype_bool("bool")
+
+
+def test_modify_tensor_max_change():
+    original = jnp.array([1.0, 10.0, -10.0])
+    target = jnp.array([2.0, 10.5, -20.0])
+    out = misc.modify_tensor(original, target, max_change=0.2)
+    # change limited to 20% of |original|
+    assert np.allclose(np.asarray(out), [1.2, 10.5, -12.0])
+
+
+def test_modify_tensor_bounds():
+    original = jnp.array([0.0, 0.0])
+    target = jnp.array([5.0, -5.0])
+    out = misc.modify_tensor(original, target, lb=-1.0, ub=2.0)
+    assert np.allclose(np.asarray(out), [2.0, -1.0])
+
+
+def test_split_workload():
+    assert misc.split_workload(10, 3) == [4, 3, 3]
+    assert sum(misc.split_workload(113, 8)) == 113
+
+
+def test_stdev_from_radius():
+    assert misc.stdev_from_radius(4.0, 16) == pytest.approx(1.0)
+    assert misc.to_stdev_init(solution_length=16, radius_init=4.0) == pytest.approx(1.0)
+    assert misc.to_stdev_init(solution_length=16, stdev_init=0.5) == pytest.approx(0.5)
+    with pytest.raises(ValueError):
+        misc.to_stdev_init(solution_length=16)
+    with pytest.raises(ValueError):
+        misc.to_stdev_init(solution_length=16, stdev_init=1.0, radius_init=1.0)
+
+
+def test_ensure_array_length_and_dtype():
+    out = misc.ensure_array_length_and_dtype(3.0, 4, "float32")
+    assert out.shape == (4,)
+    out = misc.ensure_array_length_and_dtype([1, 2, 3], 3, "float32")
+    assert out.dtype == jnp.float32
+    with pytest.raises(ValueError):
+        misc.ensure_array_length_and_dtype([1, 2], 3, "float32")
+
+
+def test_erroneous_result():
+    def boom():
+        raise RuntimeError("nope")
+
+    r = misc.ErroneousResult.call(boom)
+    assert isinstance(r, misc.ErroneousResult)
+    assert not r
+    ok = misc.ErroneousResult.call(lambda: 5)
+    assert ok == 5
+
+
+def test_cast_arrays_in_container():
+    container = {"a": jnp.zeros(3), "b": [jnp.ones(2, dtype=jnp.int32)]}
+    out = misc.cast_arrays_in_container(container, dtype="float32")
+    assert out["a"].dtype == jnp.float32
+    assert out["b"][0].dtype == jnp.float32
+    assert misc.dtype_of_container(out) == jnp.float32
+
+
+def test_tensormaker():
+    class Owner(tools.TensorMakerMixin):
+        dtype = jnp.float32
+        solution_length = 5
+
+        def __init__(self):
+            import jax
+
+            self._key = jax.random.key(0)
+
+        def next_rng_key(self):
+            import jax
+
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    o = Owner()
+    assert o.make_zeros(num_solutions=3).shape == (3, 5)
+    assert o.make_ones().shape == (5,)
+    assert bool(jnp.all(jnp.isnan(o.make_nan(2))))
+    assert o.make_I().shape == (5, 5)
+    u = o.make_uniform(num_solutions=10, lb=-1.0, ub=1.0)
+    assert u.shape == (10, 5)
+    assert float(jnp.min(u)) >= -1.0 and float(jnp.max(u)) <= 1.0
+    g = o.make_gaussian(num_solutions=4, center=2.0, stdev=0.0)
+    assert np.allclose(np.asarray(g), 2.0)
+    sym = o.make_gaussian(num_solutions=4, symmetric=True)
+    assert np.allclose(np.asarray(sym[:2]), -np.asarray(sym[2:][::-1]) * 1.0) or np.allclose(
+        np.asarray(sym[:2]), -np.asarray(sym[2:])
+    )
+    ri = o.make_randint(num_solutions=6, n=3)
+    assert int(jnp.min(ri)) >= 0 and int(jnp.max(ri)) < 3
+
+
+def test_ensure_array_object_dtype():
+    from evotorch_tpu.tools import ObjectArray
+
+    out = misc.ensure_array_length_and_dtype([[1, 2], "x", None], 3, object)
+    assert isinstance(out, ObjectArray)
+    assert len(out) == 3
+    with pytest.raises(ValueError):
+        misc.ensure_array_length_and_dtype([1, 2], 3, object)
+
+
+def test_tensormaker_eval_dtype():
+    class Owner(tools.TensorMakerMixin):
+        dtype = jnp.bfloat16
+        eval_dtype = jnp.float32
+        solution_length = 4
+
+        def next_rng_key(self):
+            import jax
+
+            return jax.random.key(0)
+
+    o = Owner()
+    assert o.make_zeros(num_solutions=2).dtype == jnp.bfloat16
+    assert o.make_zeros(num_solutions=2, use_eval_dtype=True).dtype == jnp.float32
+    assert o.make_uniform(num_solutions=2, use_eval_dtype=True).dtype == jnp.float32
