@@ -8,7 +8,20 @@ tests/conftest.py:24-40); our analog is a virtual 8-device CPU mesh via
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests even when the shell preconfigures a TPU platform
+# (JAX_PLATFORMS=axon): tests need the virtual 8-device mesh and full-f32
+# matmul numerics. Benchmarks (bench.py) run on the real chip instead.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The TPU ("axon") PJRT plugin is injected at interpreter startup via
+# sitecustomize in /root/.axon_site (PYTHONPATH), which pins
+# jax_platforms='axon' in jax's config BEFORE this conftest runs — so setting
+# the env var alone is not enough, and initializing the axon backend can hang
+# indefinitely when the device tunnel is unhealthy. Override the config
+# directly; jax then only ever initializes the CPU backend.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -5,9 +5,34 @@ Design stance (SURVEY.md Â§7): the pure-functional ask/tell layer is the core â€
 pytree states, ``jit``/``vmap``/``shard_map`` everywhere â€” and thin stateful
 wrappers reproduce the reference's OO ergonomics (Problem / SearchAlgorithm /
 status / loggers) on top. Ray actors are replaced by SPMD over the device mesh.
+
+Package entry parity: reference ``src/evotorch/__init__.py:29-38`` re-exports
+``Problem, Solution, SolutionBatch, ProblemBoundEvaluator`` and subpackages.
 """
 
-from . import decorators, tools
+from . import decorators, distributions, logging, operators, optimizers, parallel, tools
+from .core import Problem, ProblemBoundEvaluator, Solution, SolutionBatch, SolutionBatchPieces
 from .decorators import expects_ndim, on_aux_device, on_device, pass_info, rowwise, vectorized
+
+__all__ = [
+    "Problem",
+    "ProblemBoundEvaluator",
+    "Solution",
+    "SolutionBatch",
+    "SolutionBatchPieces",
+    "decorators",
+    "distributions",
+    "logging",
+    "operators",
+    "optimizers",
+    "parallel",
+    "tools",
+    "expects_ndim",
+    "on_aux_device",
+    "on_device",
+    "pass_info",
+    "rowwise",
+    "vectorized",
+]
 
 __version__ = "0.1.0"

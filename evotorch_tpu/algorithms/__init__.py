@@ -1,0 +1,35 @@
+"""Search algorithms (L5).
+
+Parity: reference ``algorithms/__init__.py`` — distribution-based searchers
+(PGPE, SNES, XNES, CEM, CMAES, PyCMAES), population-based searchers
+(GeneticAlgorithm, SteadyStateGA, Cosyne, MAPElites), restart meta-algorithms,
+and the pure-functional subpackage.
+"""
+
+from . import functional
+from .cmaes import CMAES, PyCMAES
+from .ga import Cosyne, GeneticAlgorithm, SteadyStateGA
+from .gaussian import CEM, PGPE, SNES, XNES, GaussianSearchAlgorithm
+from .mapelites import MAPElites
+from .restarter import IPOP, ModifyingRestart, Restart
+from .searchalgorithm import SearchAlgorithm, SinglePopulationAlgorithmMixin
+
+__all__ = [
+    "functional",
+    "CMAES",
+    "PyCMAES",
+    "Cosyne",
+    "GeneticAlgorithm",
+    "SteadyStateGA",
+    "CEM",
+    "PGPE",
+    "SNES",
+    "XNES",
+    "GaussianSearchAlgorithm",
+    "MAPElites",
+    "IPOP",
+    "ModifyingRestart",
+    "Restart",
+    "SearchAlgorithm",
+    "SinglePopulationAlgorithmMixin",
+]

@@ -1,0 +1,38 @@
+"""Pure-functional ask/tell algorithms and optimizers.
+
+Parity: reference ``algorithms/functional/__init__.py`` — ``cem``/``pgpe``
+searches and ``adam``/``clipup``/``sgd`` optimizers, all pytree-state based
+and batchable (extra leftmost dims on states/hyperparams = batched searches).
+"""
+
+from .funcadam import AdamState, adam, adam_ask, adam_tell
+from .funcclipup import ClipUpState, clipup, clipup_ask, clipup_tell
+from .funccem import CEMState, cem, cem_ask, cem_tell
+from .funcpgpe import PGPEState, pgpe, pgpe_ask, pgpe_tell
+from .funcsgd import SGDState, sgd, sgd_ask, sgd_tell
+from .misc import OptimizerFunctions, get_functional_optimizer
+
+__all__ = [
+    "AdamState",
+    "adam",
+    "adam_ask",
+    "adam_tell",
+    "ClipUpState",
+    "clipup",
+    "clipup_ask",
+    "clipup_tell",
+    "CEMState",
+    "cem",
+    "cem_ask",
+    "cem_tell",
+    "PGPEState",
+    "pgpe",
+    "pgpe_ask",
+    "pgpe_tell",
+    "SGDState",
+    "sgd",
+    "sgd_ask",
+    "sgd_tell",
+    "OptimizerFunctions",
+    "get_functional_optimizer",
+]

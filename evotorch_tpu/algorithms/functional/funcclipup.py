@@ -1,0 +1,89 @@
+"""Functional ClipUp optimizer: ``clipup`` / ``clipup_ask`` / ``clipup_tell``.
+
+Parity: reference ``algorithms/functional/funcclipup.py:23-151`` (and the
+stateful ``optimizers.py:231-418``): normalize the gradient to
+``center_learning_rate``, momentum-accumulate the velocity, clip the velocity
+norm to ``max_speed`` (default ``2 * center_learning_rate``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...decorators import expects_ndim
+from ...tools.pytree import pytree_dataclass, replace
+
+__all__ = ["ClipUpState", "clipup", "clipup_ask", "clipup_tell"]
+
+
+@pytree_dataclass
+class ClipUpState:
+    center: jnp.ndarray
+    velocity: jnp.ndarray
+    center_learning_rate: jnp.ndarray
+    momentum: jnp.ndarray
+    max_speed: jnp.ndarray
+
+
+def clipup(
+    *,
+    center_init,
+    momentum=0.9,
+    center_learning_rate: Optional[float] = None,
+    max_speed: Optional[float] = None,
+) -> ClipUpState:
+    """Initialize ClipUp (reference ``funcclipup.py:31-92``). At least one of
+    ``center_learning_rate`` / ``max_speed`` is required; the missing one is
+    derived via the factor-of-2 rule."""
+    center_init = jnp.asarray(center_init)
+    dtype = center_init.dtype
+    as_arr = lambda x: jnp.asarray(x, dtype=dtype)  # noqa: E731
+    if center_learning_rate is None and max_speed is None:
+        raise ValueError(
+            "Both `center_learning_rate` and `max_speed` are missing. At least one of them is needed."
+        )
+    if max_speed is None:
+        center_learning_rate = as_arr(center_learning_rate)
+        max_speed = center_learning_rate * 2.0
+    elif center_learning_rate is None:
+        max_speed = as_arr(max_speed)
+        center_learning_rate = max_speed / 2.0
+    else:
+        center_learning_rate = as_arr(center_learning_rate)
+        max_speed = as_arr(max_speed)
+    return ClipUpState(
+        center=center_init,
+        velocity=jnp.zeros_like(center_init),
+        center_learning_rate=center_learning_rate,
+        momentum=as_arr(momentum),
+        max_speed=max_speed,
+    )
+
+
+@expects_ndim(1, 1, 1, 0, 0, 0)
+def _clipup_step(g, center, velocity, center_learning_rate, momentum, max_speed):
+    gnorm = jnp.linalg.norm(g)
+    velocity = momentum * velocity + center_learning_rate * (g / gnorm)
+    vnorm = jnp.linalg.norm(velocity)
+    velocity = jnp.where(vnorm > max_speed, max_speed * (velocity / vnorm), velocity)
+    center = center + velocity
+    return velocity, center
+
+
+def clipup_ask(state: ClipUpState) -> jnp.ndarray:
+    return state.center
+
+
+def clipup_tell(state: ClipUpState, *, follow_grad) -> ClipUpState:
+    """Apply an ascent gradient (reference ``funcclipup.py:119-151``)."""
+    velocity, center = _clipup_step(
+        follow_grad,
+        state.center,
+        state.velocity,
+        state.center_learning_rate,
+        state.momentum,
+        state.max_speed,
+    )
+    return replace(state, center=center, velocity=velocity)

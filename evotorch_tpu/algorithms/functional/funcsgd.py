@@ -1,0 +1,63 @@
+"""Functional SGD (with optional momentum): ``sgd`` / ``sgd_ask`` / ``sgd_tell``.
+
+Parity: reference ``algorithms/functional/funcsgd.py:23-130``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...decorators import expects_ndim
+from ...tools.pytree import pytree_dataclass, replace
+
+__all__ = ["SGDState", "sgd", "sgd_ask", "sgd_tell"]
+
+
+@pytree_dataclass
+class SGDState:
+    center: jnp.ndarray
+    velocity: jnp.ndarray
+    center_learning_rate: jnp.ndarray
+    momentum: jnp.ndarray
+
+
+def sgd(
+    *,
+    center_init,
+    center_learning_rate,
+    momentum: Optional[float] = None,
+) -> SGDState:
+    """Initialize SGD (reference ``funcsgd.py:30-77``). ``momentum=None``
+    means plain gradient ascent."""
+    center_init = jnp.asarray(center_init)
+    dtype = center_init.dtype
+    return SGDState(
+        center=center_init,
+        velocity=jnp.zeros_like(center_init),
+        center_learning_rate=jnp.asarray(center_learning_rate, dtype=dtype),
+        momentum=jnp.asarray(0.0 if momentum is None else momentum, dtype=dtype),
+    )
+
+
+@expects_ndim(1, 1, 1, 0, 0)
+def _sgd_step(g, center, velocity, center_learning_rate, momentum):
+    velocity = momentum * velocity + center_learning_rate * g
+    center = center + velocity
+    return velocity, center
+
+
+def sgd_ask(state: SGDState) -> jnp.ndarray:
+    return state.center
+
+
+def sgd_tell(state: SGDState, *, follow_grad) -> SGDState:
+    velocity, center = _sgd_step(
+        follow_grad,
+        state.center,
+        state.velocity,
+        state.center_learning_rate,
+        state.momentum,
+    )
+    return replace(state, center=center, velocity=velocity)

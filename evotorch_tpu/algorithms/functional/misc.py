@@ -1,0 +1,34 @@
+"""Functional-optimizer registry (reference ``algorithms/functional/misc.py:26-76``)."""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple, Union
+
+__all__ = ["OptimizerFunctions", "get_functional_optimizer"]
+
+
+class OptimizerFunctions(NamedTuple):
+    initialize: callable
+    ask: callable
+    tell: callable
+
+
+def get_functional_optimizer(optimizer: Union[str, tuple]) -> OptimizerFunctions:
+    """``"adam"`` -> ``(adam, adam_ask, adam_tell)`` etc.; a 3-tuple of
+    callables passes through as a custom optimizer."""
+    from .funcadam import adam, adam_ask, adam_tell
+    from .funcclipup import clipup, clipup_ask, clipup_tell
+    from .funcsgd import sgd, sgd_ask, sgd_tell
+
+    if optimizer == "adam":
+        return OptimizerFunctions(adam, adam_ask, adam_tell)
+    if optimizer == "clipup":
+        return OptimizerFunctions(clipup, clipup_ask, clipup_tell)
+    if optimizer in ("sgd", "sga", "momentum"):
+        return OptimizerFunctions(sgd, sgd_ask, sgd_tell)
+    if isinstance(optimizer, str):
+        raise ValueError(f"Unrecognized functional optimizer name: {optimizer}")
+    if isinstance(optimizer, Iterable):
+        a, b, c = optimizer
+        return OptimizerFunctions(a, b, c)
+    raise TypeError(f"Unrecognized optimizer specification: {optimizer!r}")

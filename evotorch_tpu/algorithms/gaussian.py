@@ -1,0 +1,545 @@
+"""Distribution-based searchers: the shared Gaussian engine and
+PGPE / SNES / CEM / XNES.
+
+Parity: reference ``algorithms/distributed/gaussian.py`` —
+``GaussianSearchAlgorithm`` (``gaussian.py:35-500``: non-distributed step
+``gaussian.py:274-367``, distributed step ``gaussian.py:199-272``, controlled
+sigma update ``gaussian.py:369-419``), ``PGPE`` (``gaussian.py:503-743``),
+``SNES`` (``gaussian.py:746-983``), ``CEM`` (``gaussian.py:986-1180``),
+``XNES`` (``gaussian.py:1183-1405``).
+
+TPU notes: "distributed" here no longer means Ray actors — with
+``distributed=True`` the step calls ``problem.sample_and_compute_gradients``
+whose sharded form runs the sample/eval/rank/grad pipeline over the device
+mesh with a ``pmean`` reduction (see ``evotorch_tpu.parallel.grad``). The
+adaptive-popsize loop driven by ``num_interactions`` (``gaussian.py:296-349``)
+is host-side control flow around jitted evaluations, exactly as the reference
+runs it around torch kernels.
+"""
+
+from __future__ import annotations
+
+import math
+from copy import deepcopy
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import Problem, SolutionBatch
+from ..distributions import (
+    Distribution,
+    ExpGaussian,
+    ExpSeparableGaussian,
+    SeparableGaussian,
+    SymmetricSeparableGaussian,
+)
+from ..optimizers import get_optimizer_class
+from ..tools.misc import modify_tensor, to_stdev_init
+from .searchalgorithm import SearchAlgorithm, SinglePopulationAlgorithmMixin
+
+__all__ = ["GaussianSearchAlgorithm", "PGPE", "SNES", "CEM", "XNES"]
+
+
+class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
+    """Shared engine for PGPE/SNES/CEM/XNES (reference ``gaussian.py:35``)."""
+
+    DISTRIBUTION_TYPE = NotImplemented
+    DISTRIBUTION_PARAMS: Optional[dict] = None
+
+    def __init__(
+        self,
+        problem: Problem,
+        *,
+        popsize: int,
+        center_learning_rate: float,
+        stdev_learning_rate: float,
+        stdev_init=None,
+        radius_init=None,
+        num_interactions: Optional[int] = None,
+        popsize_max: Optional[int] = None,
+        optimizer=None,
+        optimizer_config: Optional[dict] = None,
+        ranking_method: Optional[str] = None,
+        center_init=None,
+        stdev_min=None,
+        stdev_max=None,
+        stdev_max_change=None,
+        obj_index: Optional[int] = None,
+        distributed: bool = False,
+        popsize_weighted_grad_avg: Optional[bool] = None,
+        ensure_even_popsize: bool = False,
+    ):
+        problem.ensure_numeric()
+        problem.ensure_unbounded()
+
+        SearchAlgorithm.__init__(
+            self,
+            problem,
+            center=self._get_mu,
+            stdev=self._get_sigma,
+            mean_eval=self._get_mean_eval,
+        )
+
+        self._ensure_even_popsize = bool(ensure_even_popsize)
+        if self._ensure_even_popsize and popsize % 2 != 0:
+            raise ValueError(f"popsize must be even, got {popsize}")
+
+        if not distributed and num_interactions is not None:
+            self.add_status_getters({"popsize": self._get_popsize})
+
+        if center_init is None:
+            mu = problem.generate_values(1).reshape(-1)
+        else:
+            mu = problem.ensure_tensor_length_and_dtype(
+                center_init, allow_scalar=False, about="center_init"
+            )
+
+        stdev_init = to_stdev_init(
+            solution_length=problem.solution_length, stdev_init=stdev_init, radius_init=radius_init
+        )
+        sigma = problem.ensure_tensor_length_and_dtype(stdev_init, about="stdev_init")
+
+        dist_cls = self.DISTRIBUTION_TYPE
+        dist_params = deepcopy(self.DISTRIBUTION_PARAMS) if self.DISTRIBUTION_PARAMS is not None else {}
+        dist_params.update({"mu": mu, "sigma": sigma})
+        self._distribution: Distribution = dist_cls(dist_params, dtype=problem.dtype)
+
+        self._popsize = int(popsize)
+        self._popsize_max = None if popsize_max is None else int(popsize_max)
+        self._num_interactions = None if num_interactions is None else int(num_interactions)
+
+        self._center_learning_rate = float(center_learning_rate)
+        self._stdev_learning_rate = float(stdev_learning_rate)
+        self._optimizer = self._initialize_optimizer(self._center_learning_rate, optimizer, optimizer_config)
+        self._ranking_method = None if ranking_method is None else str(ranking_method)
+
+        ensure = problem.ensure_tensor_length_and_dtype
+        self._stdev_min = None if stdev_min is None else ensure(stdev_min, about="stdev_min")
+        self._stdev_max = None if stdev_max is None else ensure(stdev_max, about="stdev_max")
+        self._stdev_max_change = (
+            None if stdev_max_change is None else ensure(stdev_max_change, about="stdev_max_change")
+        )
+
+        self._obj_index = problem.normalize_obj_index(obj_index)
+        self._distributed = bool(distributed)
+
+        if distributed:
+            self._step = self._step_distributed
+        else:
+            self._step = self._step_non_distributed
+            if popsize_weighted_grad_avg is not None:
+                raise ValueError(
+                    "popsize_weighted_grad_avg is only meaningful in distributed mode"
+                )
+
+        if popsize_weighted_grad_avg is None:
+            self._popsize_weighted_grad_avg = num_interactions is None
+        else:
+            self._popsize_weighted_grad_avg = bool(popsize_weighted_grad_avg)
+
+        self._mean_eval: Optional[float] = None
+        self._population: Optional[SolutionBatch] = None
+        self._first_iter = True
+
+        SinglePopulationAlgorithmMixin.__init__(
+            self, exclude={"mean_eval"}, enable=(not distributed)
+        )
+
+    # ------------------------------------------------------------ properties
+    @property
+    def population(self) -> SolutionBatch:
+        if self._population is None:
+            raise RuntimeError("The population is not ready yet; take a step first")
+        return self._population
+
+    @property
+    def distribution(self) -> Distribution:
+        return self._distribution
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def obj_index(self) -> int:
+        return self._obj_index
+
+    def _get_mu(self):
+        return self._distribution.parameters["mu"]
+
+    def _get_sigma(self):
+        sigma = self._distribution.parameters["sigma"]
+        return sigma
+
+    def _get_mean_eval(self):
+        return self._mean_eval
+
+    def _get_popsize(self):
+        return 0 if self._population is None else len(self._population)
+
+    # -------------------------------------------------------------- plumbing
+    def _initialize_optimizer(self, learning_rate, optimizer, optimizer_config):
+        if optimizer is None:
+            return None
+        if isinstance(optimizer, str):
+            cls = get_optimizer_class(optimizer, optimizer_config)
+            return cls(
+                stepsize=float(learning_rate),
+                dtype=self._distribution.dtype,
+                solution_length=self._distribution.solution_length,
+            )
+        return optimizer
+
+    def _step(self):  # replaced in __init__
+        raise NotImplementedError
+
+    # -------------------------------------------------------- non-distributed
+    def _sample_population(self, popsize: int) -> SolutionBatch:
+        samples = self._distribution.sample(popsize, key=self._problem.next_rng_key())
+        return SolutionBatch(self._problem, samples.shape[0], values=samples)
+
+    def _fill_and_eval_pop(self):
+        """Sample + evaluate, with the adaptive-popsize loop when
+        ``num_interactions`` is configured (reference ``gaussian.py:276-349``)."""
+        problem = self._problem
+        if self._num_interactions is None:
+            self._population = self._sample_population(self._popsize)
+            problem.evaluate(self._population)
+            return
+        first_count = problem.status.get("total_interaction_count", 0)
+        batches = []
+        total_popsize = 0
+        while True:
+            batch = self._sample_population(self._popsize)
+            problem.evaluate(batch)
+            batches.append(batch)
+            total_popsize += len(batch)
+            if self._popsize_max is not None and total_popsize >= self._popsize_max:
+                break
+            interactions_made = problem.status.get("total_interaction_count", 0) - first_count
+            if interactions_made > self._num_interactions:
+                break
+            if "total_interaction_count" not in problem.status:
+                break  # the problem does not report interactions; avoid looping forever
+        self._population = batches[0] if len(batches) == 1 else SolutionBatch.cat(batches)
+
+    def _step_non_distributed(self):
+        """Reference ``gaussian.py:274-367``: from generation 1 on, compute
+        gradients from the previous population, update the distribution, then
+        resample and evaluate."""
+        if self._first_iter:
+            self._first_iter = False
+            self._fill_and_eval_pop()
+            self._mean_eval = float(
+                np.nanmean(np.asarray(self._population.evals[:, self._obj_index]))
+            )
+            return
+        pop = self._population
+        samples = pop.values
+        fitnesses = pop.evals[:, self._obj_index]
+        obj_sense = self._problem.senses[self._obj_index]
+        grads = self._distribution.compute_gradients(
+            samples,
+            fitnesses,
+            objective_sense=obj_sense,
+            ranking_method=self._ranking_method if self._ranking_method is not None else "raw",
+        )
+        self._update_distribution(grads)
+        self._fill_and_eval_pop()
+        self._mean_eval = float(
+            np.nanmean(np.asarray(self._population.evals[:, self._obj_index]))
+        )
+
+    # ------------------------------------------------------------ distributed
+    def _step_distributed(self):
+        """Reference ``gaussian.py:199-272``: gather per-shard gradient dicts
+        and average them (weighted by sub-population size when configured)."""
+        results = self._problem.sample_and_compute_gradients(
+            self._distribution,
+            self._popsize,
+            popsize_max=self._popsize_max,
+            num_interactions=self._num_interactions,
+            ranking_method=self._ranking_method if self._ranking_method is not None else "raw",
+            obj_index=self._obj_index,
+        )
+        grads_list = [r["gradients"] for r in results]
+        nums = np.asarray([r["num_solutions"] for r in results], dtype=np.float64)
+        if self._popsize_weighted_grad_avg:
+            weights = nums / nums.sum()
+        else:
+            weights = np.full(len(results), 1.0 / len(results))
+        avg = {}
+        for k in grads_list[0]:
+            avg[k] = sum(w * g[k] for w, g in zip(weights, grads_list))
+        mean_evals = np.asarray([r["mean_eval"] for r in results])
+        self._mean_eval = float(np.sum((nums / nums.sum()) * mean_evals))
+        self._update_distribution(avg)
+
+    # --------------------------------------------------------------- updates
+    def _update_distribution(self, gradients: dict):
+        """Distribution update + controlled sigma clamping
+        (reference ``gaussian.py:369-419``)."""
+        learning_rates = {"mu": self._center_learning_rate, "sigma": self._stdev_learning_rate}
+        optimizers = {"mu": self._optimizer} if self._optimizer is not None else None
+        old_sigma = self._distribution.parameters["sigma"]
+        new_dist = self._distribution.update_parameters(
+            gradients, learning_rates=learning_rates, optimizers=optimizers
+        )
+        if (
+            self._stdev_min is not None
+            or self._stdev_max is not None
+            or self._stdev_max_change is not None
+        ):
+            clamped = modify_tensor(
+                old_sigma,
+                new_dist.parameters["sigma"],
+                lb=self._stdev_min,
+                ub=self._stdev_max,
+                max_change=self._stdev_max_change,
+            )
+            new_dist = new_dist.modified_copy(sigma=clamped)
+        self._distribution = new_dist
+
+
+class PGPE(GaussianSearchAlgorithm):
+    """PGPE with 0-centered ranking and ClipUp, the configuration of
+    Toklu et al. (2020) (reference ``gaussian.py:503-743``)."""
+
+    DISTRIBUTION_TYPE = NotImplemented  # set per instance (symmetric or not)
+    DISTRIBUTION_PARAMS = NotImplemented
+
+    def __init__(
+        self,
+        problem: Problem,
+        *,
+        popsize: int,
+        center_learning_rate: float,
+        stdev_learning_rate: float,
+        stdev_init=None,
+        radius_init=None,
+        num_interactions: Optional[int] = None,
+        popsize_max: Optional[int] = None,
+        optimizer="clipup",
+        optimizer_config: Optional[dict] = None,
+        ranking_method: Optional[str] = "centered",
+        center_init=None,
+        stdev_min=None,
+        stdev_max=None,
+        stdev_max_change=0.2,
+        symmetric: bool = True,
+        obj_index: Optional[int] = None,
+        distributed: bool = False,
+        popsize_weighted_grad_avg: Optional[bool] = None,
+    ):
+        if symmetric:
+            self.DISTRIBUTION_TYPE = SymmetricSeparableGaussian
+            divide_by = "num_directions"
+        else:
+            self.DISTRIBUTION_TYPE = SeparableGaussian
+            divide_by = "num_solutions"
+        self.DISTRIBUTION_PARAMS = {
+            "divide_mu_grad_by": divide_by,
+            "divide_sigma_grad_by": divide_by,
+        }
+        super().__init__(
+            problem,
+            popsize=popsize,
+            center_learning_rate=center_learning_rate,
+            stdev_learning_rate=stdev_learning_rate,
+            stdev_init=stdev_init,
+            radius_init=radius_init,
+            popsize_max=popsize_max,
+            num_interactions=num_interactions,
+            optimizer=optimizer,
+            optimizer_config=optimizer_config,
+            ranking_method=ranking_method,
+            center_init=center_init,
+            stdev_min=stdev_min,
+            stdev_max=stdev_max,
+            stdev_max_change=stdev_max_change,
+            obj_index=obj_index,
+            distributed=distributed,
+            popsize_weighted_grad_avg=popsize_weighted_grad_avg,
+            ensure_even_popsize=symmetric,
+        )
+
+
+class SNES(GaussianSearchAlgorithm):
+    """Separable NES (Schaul et al. 2011; reference ``gaussian.py:746-983``)."""
+
+    DISTRIBUTION_TYPE = ExpSeparableGaussian
+    DISTRIBUTION_PARAMS = None
+
+    def __init__(
+        self,
+        problem: Problem,
+        *,
+        stdev_init=None,
+        radius_init=None,
+        popsize: Optional[int] = None,
+        center_learning_rate: Optional[float] = None,
+        stdev_learning_rate: Optional[float] = None,
+        scale_learning_rate: bool = True,
+        num_interactions: Optional[int] = None,
+        popsize_max: Optional[int] = None,
+        optimizer=None,
+        optimizer_config: Optional[dict] = None,
+        ranking_method: Optional[str] = "nes",
+        center_init=None,
+        stdev_min=None,
+        stdev_max=None,
+        stdev_max_change=None,
+        obj_index: Optional[int] = None,
+        distributed: bool = False,
+        popsize_weighted_grad_avg: Optional[bool] = None,
+    ):
+        if popsize is None:
+            popsize = int(4 + math.floor(3 * math.log(problem.solution_length)))
+        if center_learning_rate is None:
+            center_learning_rate = 1.0
+
+        def default_stdev_lr():
+            n = problem.solution_length
+            return 0.2 * (3 + math.log(n)) / math.sqrt(n)
+
+        if stdev_learning_rate is None:
+            stdev_learning_rate = default_stdev_lr()
+        else:
+            stdev_learning_rate = float(stdev_learning_rate)
+            if scale_learning_rate:
+                stdev_learning_rate *= default_stdev_lr()
+
+        super().__init__(
+            problem,
+            popsize=popsize,
+            center_learning_rate=center_learning_rate,
+            stdev_learning_rate=stdev_learning_rate,
+            stdev_init=stdev_init,
+            radius_init=radius_init,
+            popsize_max=popsize_max,
+            num_interactions=num_interactions,
+            optimizer=optimizer,
+            optimizer_config=optimizer_config,
+            ranking_method=ranking_method,
+            center_init=center_init,
+            stdev_min=stdev_min,
+            stdev_max=stdev_max,
+            stdev_max_change=stdev_max_change,
+            obj_index=obj_index,
+            distributed=distributed,
+            popsize_weighted_grad_avg=popsize_weighted_grad_avg,
+        )
+
+
+class CEM(GaussianSearchAlgorithm):
+    """Cross-entropy method, Duan et al. (2016) variant
+    (reference ``gaussian.py:986-1180``)."""
+
+    DISTRIBUTION_TYPE = SeparableGaussian
+    DISTRIBUTION_PARAMS = NotImplemented  # set per instance
+
+    def __init__(
+        self,
+        problem: Problem,
+        *,
+        popsize: int,
+        parenthood_ratio: float,
+        stdev_init=None,
+        radius_init=None,
+        num_interactions: Optional[int] = None,
+        popsize_max: Optional[int] = None,
+        center_init=None,
+        stdev_min=None,
+        stdev_max=None,
+        stdev_max_change=None,
+        obj_index: Optional[int] = None,
+        distributed: bool = False,
+        popsize_weighted_grad_avg: Optional[bool] = None,
+    ):
+        self.DISTRIBUTION_PARAMS = {"parenthood_ratio": float(parenthood_ratio)}
+        super().__init__(
+            problem,
+            popsize=popsize,
+            center_learning_rate=1.0,
+            stdev_learning_rate=1.0,
+            stdev_init=stdev_init,
+            radius_init=radius_init,
+            popsize_max=popsize_max,
+            num_interactions=num_interactions,
+            optimizer=None,
+            optimizer_config=None,
+            ranking_method=None,
+            center_init=center_init,
+            stdev_min=stdev_min,
+            stdev_max=stdev_max,
+            stdev_max_change=stdev_max_change,
+            obj_index=obj_index,
+            distributed=distributed,
+            popsize_weighted_grad_avg=popsize_weighted_grad_avg,
+        )
+
+
+class XNES(GaussianSearchAlgorithm):
+    """Exponential NES with full covariance (Glasmachers et al. 2010;
+    reference ``gaussian.py:1183-1405``)."""
+
+    DISTRIBUTION_TYPE = ExpGaussian
+    DISTRIBUTION_PARAMS = None
+
+    def __init__(
+        self,
+        problem: Problem,
+        *,
+        stdev_init=None,
+        radius_init=None,
+        popsize: Optional[int] = None,
+        center_learning_rate: Optional[float] = None,
+        stdev_learning_rate: Optional[float] = None,
+        scale_learning_rate: bool = True,
+        num_interactions: Optional[int] = None,
+        popsize_max: Optional[int] = None,
+        optimizer=None,
+        optimizer_config: Optional[dict] = None,
+        ranking_method: Optional[str] = "nes",
+        center_init=None,
+        obj_index: Optional[int] = None,
+        distributed: bool = False,
+        popsize_weighted_grad_avg: Optional[bool] = None,
+    ):
+        if popsize is None:
+            popsize = int(4 + math.floor(3 * math.log(problem.solution_length)))
+        if center_learning_rate is None:
+            center_learning_rate = 1.0
+
+        def default_stdev_lr():
+            n = problem.solution_length
+            return 0.6 * (3 + math.log(n)) / (n * math.sqrt(n))
+
+        if stdev_learning_rate is None:
+            stdev_learning_rate = default_stdev_lr()
+        else:
+            stdev_learning_rate = float(stdev_learning_rate)
+            if scale_learning_rate:
+                stdev_learning_rate *= default_stdev_lr()
+
+        super().__init__(
+            problem,
+            popsize=popsize,
+            center_learning_rate=center_learning_rate,
+            stdev_learning_rate=stdev_learning_rate,
+            stdev_init=stdev_init,
+            radius_init=radius_init,
+            popsize_max=popsize_max,
+            num_interactions=num_interactions,
+            optimizer=optimizer,
+            optimizer_config=optimizer_config,
+            ranking_method=ranking_method,
+            center_init=center_init,
+            stdev_min=None,
+            stdev_max=None,
+            stdev_max_change=None,
+            obj_index=obj_index,
+            distributed=distributed,
+            popsize_weighted_grad_avg=popsize_weighted_grad_avg,
+        )

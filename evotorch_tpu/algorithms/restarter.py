@@ -1,0 +1,106 @@
+"""Meta-algorithms: independent restarts.
+
+Parity: reference ``algorithms/restarter/`` — ``Restart``
+(``restart.py:21-74``), ``ModifyingRestart`` / ``IPOP``
+(``modify_restart.py:23-72``). These are *algorithmic* restarts on search
+stagnation, not fault tolerance (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Optional, Type
+
+import numpy as np
+
+from ..core import Problem
+from .searchalgorithm import SearchAlgorithm
+
+__all__ = ["Restart", "ModifyingRestart", "IPOP"]
+
+
+class Restart(SearchAlgorithm):
+    """Re-instantiate the inner algorithm whenever it terminates
+    (reference ``restart.py:21``)."""
+
+    def __init__(
+        self,
+        problem: Problem,
+        algorithm_class: Type[SearchAlgorithm],
+        algorithm_args: Optional[dict] = None,
+        **kwargs: Any,
+    ):
+        SearchAlgorithm.__init__(
+            self,
+            problem,
+            search_algorithm=self._get_sa_status,
+            num_restarts=self._get_num_restarts,
+            algorithm_terminated=self._search_algorithm_terminated,
+            **kwargs,
+        )
+        self._algorithm_class = algorithm_class
+        self._algorithm_args = dict(algorithm_args or {})
+        self.num_restarts = 0
+        self._restart()
+
+    def _get_sa_status(self) -> dict:
+        return dict(self.search_algorithm.status.items())
+
+    def _get_num_restarts(self) -> int:
+        return self.num_restarts
+
+    def _restart(self):
+        self.search_algorithm = self._algorithm_class(self._problem, **self._algorithm_args)
+        self.num_restarts += 1
+
+    def _search_algorithm_terminated(self) -> bool:
+        return self.search_algorithm.is_terminated
+
+    def _step(self):
+        self.search_algorithm.step()
+        if self._search_algorithm_terminated():
+            self._restart()
+
+
+class ModifyingRestart(Restart):
+    """Restart with a chance to adjust the inner algorithm's arguments
+    (reference ``modify_restart.py:23``)."""
+
+    def _modify_algorithm_args(self):
+        pass
+
+    def _restart(self):
+        self._modify_algorithm_args()
+        super()._restart()
+
+
+class IPOP(ModifyingRestart):
+    """Increasing-population restart: when the population's fitness stdev
+    collapses, restart with a multiplied popsize
+    (reference ``modify_restart.py:34-72``)."""
+
+    def __init__(
+        self,
+        problem: Problem,
+        algorithm_class: Type[SearchAlgorithm],
+        algorithm_args: Optional[dict] = None,
+        min_fitness_stdev: float = 1e-9,
+        popsize_multiplier: float = 2,
+    ):
+        super().__init__(problem, algorithm_class, algorithm_args)
+        self.min_fitness_stdev = float(min_fitness_stdev)
+        self.popsize_multiplier = float(popsize_multiplier)
+
+    def _search_algorithm_terminated(self) -> bool:
+        evals = np.asarray(self.search_algorithm.population.evals)
+        if np.nanstd(evals) < getattr(self, "min_fitness_stdev", 1e-9):
+            return True
+        return super()._search_algorithm_terminated()
+
+    def _modify_algorithm_args(self):
+        if self.num_restarts >= 1:
+            new_args = deepcopy(self._algorithm_args)
+            new_args["popsize"] = int(
+                self.popsize_multiplier * len(self.search_algorithm.population)
+            )
+            self._algorithm_args = new_args

@@ -1,0 +1,249 @@
+"""Search-algorithm base machinery.
+
+Parity: reference ``algorithms/searchalgorithm.py`` — ``LazyReporter``
+(``searchalgorithm.py:34-238``), ``SearchAlgorithm`` with hooks and
+``step()``/``run()`` orchestration (``searchalgorithm.py:240-447``), and
+``SinglePopulationAlgorithmMixin`` auto status (``searchalgorithm.py:450-584``).
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..core import Problem, SolutionBatch
+from ..tools.hook import Hook
+
+__all__ = [
+    "LazyReporter",
+    "SearchAlgorithm",
+    "SinglePopulationAlgorithmMixin",
+]
+
+
+class LazyReporter:
+    """Lazy, memoized status providers (reference ``searchalgorithm.py:34``).
+
+    Subclasses declare status items by passing ``name=getter_function`` pairs
+    to ``__init__``; each getter runs at most once per step."""
+
+    def __init__(self, **kwargs):
+        self._getters: dict = {}
+        self._computed: dict = {}
+        self.update_status_getters(kwargs)
+
+    def update_status_getters(self, getters: dict):
+        self._getters.update(getters)
+
+    # reference name (searchalgorithm.py uses add_status_getters)
+    add_status_getters = update_status_getters
+
+    def clear_status(self):
+        self._computed = {}
+
+    def update_status(self, additional_status: dict):
+        for k, v in additional_status.items():
+            if k not in self._getters:
+                self._computed[k] = v
+
+    def has_status_key(self, key: str) -> bool:
+        return key in self._computed or key in self._getters
+
+    def iter_status_keys(self):
+        seen = set()
+        for k in self._computed:
+            seen.add(k)
+            yield k
+        for k in self._getters:
+            if k not in seen:
+                yield k
+
+    def get_status_value(self, key: str):
+        if key in self._computed:
+            return self._computed[key]
+        if key in self._getters:
+            value = self._getters[key]()
+            self._computed[key] = value
+            return value
+        raise KeyError(key)
+
+    @property
+    def status(self) -> "LazyStatusDict":
+        return LazyStatusDict(self)
+
+
+class LazyStatusDict:
+    """Mapping view over a LazyReporter (reference ``searchalgorithm.py:180``)."""
+
+    def __init__(self, reporter: LazyReporter):
+        self._reporter = reporter
+
+    def __getitem__(self, key):
+        return self._reporter.get_status_value(key)
+
+    def __contains__(self, key):
+        return self._reporter.has_status_key(key)
+
+    def __iter__(self):
+        return self._reporter.iter_status_keys()
+
+    def __len__(self):
+        return sum(1 for _ in self._reporter.iter_status_keys())
+
+    def keys(self):
+        return list(iter(self))
+
+    def items(self):
+        for k in self:
+            yield k, self[k]
+
+    def values(self):
+        for k in self:
+            yield self[k]
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __repr__(self):
+        return f"<status {self.keys()}>"
+
+
+class SearchAlgorithm(LazyReporter):
+    """Base class of all search algorithms (reference
+    ``searchalgorithm.py:240``): hooks, step orchestration, run loop."""
+
+    def __init__(self, problem: Problem, **kwargs):
+        super().__init__(**kwargs)
+        self._problem = problem
+        self._before_step_hook = Hook()
+        self._after_step_hook = Hook()
+        self._log_hook = Hook()
+        self._end_of_run_hook = Hook()
+        self._steps_count = 0
+        self._first_step_datetime: Optional[datetime] = None
+
+    @property
+    def problem(self) -> Problem:
+        return self._problem
+
+    @property
+    def before_step_hook(self) -> Hook:
+        return self._before_step_hook
+
+    @property
+    def after_step_hook(self) -> Hook:
+        return self._after_step_hook
+
+    @property
+    def log_hook(self) -> Hook:
+        return self._log_hook
+
+    @property
+    def end_of_run_hook(self) -> Hook:
+        return self._end_of_run_hook
+
+    @property
+    def step_count(self) -> int:
+        return self._steps_count
+
+    @property
+    def steps_count(self) -> int:  # legacy alias (reference keeps both)
+        return self._steps_count
+
+    @property
+    def first_step_datetime(self) -> Optional[datetime]:
+        return self._first_step_datetime
+
+    @property
+    def is_terminated(self) -> bool:
+        """Overridable termination criterion (reference
+        ``searchalgorithm.py:445``)."""
+        return False
+
+    def _step(self):
+        raise NotImplementedError
+
+    def step(self):
+        """One generation (reference ``searchalgorithm.py:380-397``)."""
+        self._before_step_hook()
+        self.clear_status()
+        if self._first_step_datetime is None:
+            self._first_step_datetime = datetime.now()
+        self._step()
+        self._steps_count += 1
+        self.update_status({"iter": self._steps_count})
+        self.update_status(self._problem.status)
+        extra = self._after_step_hook.accumulate_dict()
+        if extra:
+            self.update_status(extra)
+        if len(self._log_hook) >= 1:
+            self._log_hook(dict(self.status.items()))
+
+    def run(self, num_generations: int, *, reset_first_step_datetime: bool = True):
+        """Run ``num_generations`` steps (reference ``searchalgorithm.py:409``)."""
+        if reset_first_step_datetime:
+            self.reset_first_step_datetime()
+        for _ in range(int(num_generations)):
+            self.step()
+            if self.is_terminated:
+                break
+        if len(self._end_of_run_hook) >= 1:
+            self._end_of_run_hook(dict(self.status.items()))
+
+    def reset_first_step_datetime(self):
+        self._first_step_datetime = None
+
+
+class SinglePopulationAlgorithmMixin:
+    """Auto status getters over ``.population``
+    (reference ``searchalgorithm.py:450-584``): ``pop_best``,
+    ``pop_best_eval``, ``mean_eval``, ``median_eval`` (prefixed per objective
+    in the multi-objective case)."""
+
+    def __init__(self, *, exclude: Optional[set] = None, enable: bool = True):
+        if not enable:
+            return
+        exclude = exclude or set()
+        problem = self.problem
+
+        def make_getters(obj_index: int, prefix: str):
+            def pop_best():
+                batch = self.population
+                i = int(np.asarray(batch.argbest(obj_index)))
+                return batch[i].clone()
+
+            def pop_best_eval():
+                batch = self.population
+                i = int(np.asarray(batch.argbest(obj_index)))
+                return float(np.asarray(batch.evals[i, obj_index]))
+
+            def mean_eval():
+                return float(np.nanmean(np.asarray(self.population.evals[:, obj_index])))
+
+            def median_eval():
+                return float(np.nanmedian(np.asarray(self.population.evals[:, obj_index])))
+
+            return {
+                f"{prefix}pop_best": pop_best,
+                f"{prefix}pop_best_eval": pop_best_eval,
+                f"{prefix}mean_eval": mean_eval,
+                f"{prefix}median_eval": median_eval,
+            }
+
+        # algorithms focused on a single objective (via their obj_index)
+        # report unprefixed stats for that objective even on multi-objective
+        # problems (reference searchalgorithm.py:563-574); only truly
+        # multi-objective algorithms get per-objective prefixes
+        algo_obj_index = getattr(self, "obj_index", None)
+        if problem.is_multi_objective and algo_obj_index is None:
+            getters = {}
+            for i in range(problem.num_objectives):
+                getters.update(make_getters(i, f"obj{i}_"))
+        else:
+            getters = make_getters(0 if algo_obj_index is None else int(algo_obj_index), "")
+        self.update_status_getters({k: v for k, v in getters.items() if k not in exclude})

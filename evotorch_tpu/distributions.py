@@ -1,0 +1,630 @@
+"""Search distributions (L4): the gradient-estimation heart of the ES family.
+
+Parity with the reference's ``distributions.py``:
+
+- ``Distribution`` base (``distributions.py:40-410``): parameter dict, sample,
+  ``compute_gradients`` (fitness ranking + delegation), ``update_parameters``,
+  ``_follow_gradient`` (learning-rate or optimizer ``ascent``),
+  ``modified_copy``, ``functional_sample``.
+- ``SeparableGaussian`` (``distributions.py:413-613``): PGPE non-symmetric
+  score-function gradients with configurable divisors; CEM-style elite update
+  when ``parenthood_ratio`` is present; KL divergence.
+- ``SymmetricSeparableGaussian`` (``distributions.py:616-773``): antithetic
+  pairs interleaved as ``[+e0, -e0, +e1, -e1, ...]``; gradients from
+  ``(f+ - f-)/2`` and ``(f+ + f-)/2``.
+- ``ExpSeparableGaussian`` (``distributions.py:776-810``): SNES natural
+  gradient, ``sigma <- sigma * exp(0.5 * lr * grad)``.
+- ``ExpGaussian`` (``distributions.py:813-1016``): XNES full covariance via
+  ``A`` with tracked ``A_inv``; updates through ``expm``.
+
+TPU-first design: every distribution's math lives in pure classmethods over a
+parameter dict (a pytree), so it jits/vmaps natively; the class instances are
+thin stateful conveniences. ``make_functional_sampler`` /
+``make_functional_grad_estimator`` (``distributions.py:1023-1623``) expose the
+batched pure-functional API.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from .decorators import expects_ndim
+from .tools.cloning import Serializable
+from .tools.misc import to_jax_dtype
+from .tools.ranking import rank
+from .tools.recursiveprintable import RecursivePrintable
+from .tools.tensormaker import TensorMakerMixin
+
+__all__ = [
+    "Distribution",
+    "SeparableGaussian",
+    "SymmetricSeparableGaussian",
+    "ExpSeparableGaussian",
+    "ExpGaussian",
+    "make_functional_sampler",
+    "make_functional_grad_estimator",
+]
+
+_STRING_PARAMETERS = {"divide_mu_grad_by", "divide_sigma_grad_by"}
+
+
+class Distribution(TensorMakerMixin, Serializable, RecursivePrintable):
+    """Base class for search distributions (reference ``distributions.py:40``)."""
+
+    MANDATORY_PARAMETERS: set = set()
+    OPTIONAL_PARAMETERS: set = set()
+    PARAMETER_NDIMS: dict = {}
+
+    functional_sample: Optional[Callable] = None
+
+    def __init__(
+        self,
+        *,
+        solution_length: int,
+        parameters: dict,
+        dtype=None,
+        seed: Optional[int] = None,
+    ):
+        self.solution_length = int(solution_length)
+        self.dtype = to_jax_dtype(dtype) if dtype is not None else jnp.float32
+        self._parameters = {}
+        for k, v in parameters.items():
+            if (k not in self.MANDATORY_PARAMETERS) and (k not in self.OPTIONAL_PARAMETERS):
+                raise ValueError(f"{type(self).__name__} got an unrecognized parameter: {k!r}")
+            if isinstance(v, (str, type(None))):
+                self._parameters[k] = v
+            elif isinstance(v, (int, float)) and k in ("parenthood_ratio",):
+                self._parameters[k] = float(v)
+            else:
+                self._parameters[k] = jnp.asarray(v, dtype=self.dtype)
+        for k in self.MANDATORY_PARAMETERS:
+            if k not in self._parameters:
+                raise ValueError(f"{type(self).__name__} is missing mandatory parameter {k!r}")
+        self._rng_key = jax.random.key(0 if seed is None else seed)
+
+    # -- PRNG plumbing ------------------------------------------------------
+    def manual_seed(self, seed: int):
+        self._rng_key = jax.random.key(int(seed))
+
+    def next_rng_key(self):
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    # -- parameters ----------------------------------------------------------
+    @property
+    def parameters(self) -> dict:
+        return self._parameters
+
+    def modified_copy(self, *, dtype=None, **overrides) -> "Distribution":
+        """Copy with some parameters replaced (reference ``distributions.py:328``)."""
+        params = dict(self._parameters)
+        params.update(overrides)
+        result = type(self)(
+            parameters=params,
+            solution_length=self.solution_length,
+            dtype=dtype if dtype is not None else self.dtype,
+        )
+        result._rng_key = self._rng_key
+        return result
+
+    # -- sampling ------------------------------------------------------------
+    def sample(self, num_solutions: int, *, key=None) -> jnp.ndarray:
+        """Draw ``num_solutions`` samples (reference ``distributions.py:155-216``).
+        ``key`` is an explicit JAX PRNG key; when omitted, the distribution's
+        internal key state advances (stateful convenience)."""
+        if key is None:
+            key = self.next_rng_key()
+        return self._sample(key, self._parameters, int(num_solutions))
+
+    @classmethod
+    def _sample(cls, key, parameters: dict, num_solutions: int) -> jnp.ndarray:
+        raise NotImplementedError
+
+    # -- gradients -----------------------------------------------------------
+    def compute_gradients(
+        self,
+        samples: jnp.ndarray,
+        fitnesses: jnp.ndarray,
+        *,
+        objective_sense: str,
+        ranking_method: str = "raw",
+    ) -> dict:
+        """Rank fitnesses then delegate (reference ``distributions.py:236-299``)."""
+        if objective_sense not in ("min", "max"):
+            raise ValueError(f"objective_sense must be 'min' or 'max', got {objective_sense!r}")
+        higher_is_better = objective_sense == "max"
+        weights = rank(fitnesses, ranking_method, higher_is_better=higher_is_better)
+        return self._compute_gradients(self._parameters, samples, weights, ranking_method)
+
+    @classmethod
+    def _compute_gradients(cls, parameters: dict, samples, weights, ranking_used) -> dict:
+        raise NotImplementedError
+
+    # -- updates -------------------------------------------------------------
+    def _follow_gradient(
+        self,
+        param_name: str,
+        grad: jnp.ndarray,
+        *,
+        learning_rates: Optional[dict] = None,
+        optimizers: Optional[dict] = None,
+    ) -> jnp.ndarray:
+        """Learning-rate step or optimizer ``ascent`` (reference
+        ``distributions.py:372-392``)."""
+        if optimizers is not None and param_name in optimizers:
+            return optimizers[param_name].ascent(grad)
+        if learning_rates is not None and param_name in learning_rates:
+            return jnp.asarray(learning_rates[param_name], dtype=grad.dtype) * grad
+        return grad
+
+    def update_parameters(
+        self,
+        gradients: dict,
+        *,
+        learning_rates: Optional[dict] = None,
+        optimizers: Optional[dict] = None,
+    ) -> "Distribution":
+        raise NotImplementedError
+
+    # -- misc ----------------------------------------------------------------
+    def relative_entropy(self, other: "Distribution") -> float:
+        raise NotImplementedError(
+            f"KL divergence is not defined for {type(self).__name__}"
+        )
+
+    def _printable_items(self):
+        return {"solution_length": self.solution_length, "parameters": self._parameters}
+
+
+def _zero_center_weights(weights: jnp.ndarray, ranking_used: Optional[str]) -> jnp.ndarray:
+    """Weights must be 0-centered for the score-function estimators unless the
+    ranking already guarantees it (reference ``distributions.py:560-563``)."""
+    if ranking_used not in ("centered", "normalized"):
+        weights = weights - jnp.mean(weights)
+    return weights
+
+
+def _divide_grad(parameters: dict, param_name: str, grad, weights):
+    """Configurable gradient divisor (reference ``distributions.py:517-536``)."""
+    option = f"divide_{param_name}_grad_by"
+    div_by_what = parameters.get(option, None)
+    if div_by_what is None:
+        return grad
+    if div_by_what == "num_solutions":
+        return grad / weights.shape[0]
+    if div_by_what == "num_directions":
+        return grad / (weights.shape[0] // 2)
+    if div_by_what == "total_weight":
+        return grad / jnp.sum(jnp.abs(weights))
+    if div_by_what == "weight_stdev":
+        return grad / jnp.std(weights, ddof=1)
+    raise ValueError(f"The parameter {option} has an unrecognized value: {div_by_what}")
+
+
+class SeparableGaussian(Distribution):
+    """Separable multivariate Gaussian, as used by PGPE (non-symmetric) and —
+    with ``parenthood_ratio`` — CEM (reference ``distributions.py:413-613``)."""
+
+    MANDATORY_PARAMETERS = {"mu", "sigma"}
+    OPTIONAL_PARAMETERS = {"divide_mu_grad_by", "divide_sigma_grad_by", "parenthood_ratio"}
+    PARAMETER_NDIMS = {"mu": 1, "sigma": 1}
+
+    def __init__(self, parameters: dict, *, solution_length: Optional[int] = None, dtype=None, seed=None):
+        mu = jnp.asarray(parameters["mu"])
+        if solution_length is None:
+            solution_length = mu.shape[-1]
+        elif solution_length != mu.shape[-1]:
+            raise ValueError(
+                f"solution_length={solution_length} does not match len(mu)={mu.shape[-1]}"
+            )
+        sigma = jnp.asarray(parameters["sigma"])
+        if sigma.shape[-1] != mu.shape[-1]:
+            raise ValueError(
+                f"mu and sigma have mismatching lengths: {mu.shape[-1]} vs {sigma.shape[-1]}"
+            )
+        super().__init__(solution_length=solution_length, parameters=parameters, dtype=dtype, seed=seed)
+
+    @property
+    def mu(self) -> jnp.ndarray:
+        return self._parameters["mu"]
+
+    @property
+    def sigma(self) -> jnp.ndarray:
+        return self._parameters["sigma"]
+
+    @classmethod
+    def _sample(cls, key, parameters, num_solutions):
+        mu = parameters["mu"]
+        sigma = parameters["sigma"]
+        eps = jax.random.normal(key, (num_solutions, mu.shape[-1]), dtype=mu.dtype)
+        return mu + sigma * eps
+
+    @classmethod
+    def _compute_gradients_via_parenthood_ratio(cls, parameters, samples, weights) -> dict:
+        """CEM-style elite update (reference ``distributions.py:538-546``):
+        gradient = (elite mean/std) - current (mu/sigma). Uses top-k by weight,
+        fixed elite count, so it stays jit-friendly."""
+        num_samples = samples.shape[0]
+        num_elites = int(num_samples * float(parameters["parenthood_ratio"]))
+        _, elite_indices = jax.lax.top_k(weights, num_elites)
+        elites = samples[elite_indices, :]
+        return {
+            "mu": jnp.mean(elites, axis=0) - parameters["mu"],
+            "sigma": jnp.std(elites, axis=0, ddof=1) - parameters["sigma"],
+        }
+
+    @classmethod
+    def _compute_gradients(cls, parameters, samples, weights, ranking_used) -> dict:
+        if "parenthood_ratio" in parameters:
+            return cls._compute_gradients_via_parenthood_ratio(parameters, samples, weights)
+        mu = parameters["mu"]
+        sigma = parameters["sigma"]
+        scaled_noises = samples - mu
+        weights = _zero_center_weights(weights, ranking_used)
+        mu_grad = _divide_grad(parameters, "mu", weights @ scaled_noises, weights)
+        sigma_grad = _divide_grad(
+            parameters,
+            "sigma",
+            weights @ ((scaled_noises**2 - sigma**2) / sigma),
+            weights,
+        )
+        return {"mu": mu_grad, "sigma": sigma_grad}
+
+    def update_parameters(self, gradients, *, learning_rates=None, optimizers=None):
+        new_mu = self.mu + self._follow_gradient(
+            "mu", gradients["mu"], learning_rates=learning_rates, optimizers=optimizers
+        )
+        new_sigma = self.sigma + self._follow_gradient(
+            "sigma", gradients["sigma"], learning_rates=learning_rates, optimizers=optimizers
+        )
+        return self.modified_copy(mu=new_mu, sigma=new_sigma)
+
+    def relative_entropy(self, other: "SeparableGaussian") -> float:
+        """KL(self || other) for diagonal Gaussians (reference
+        ``distributions.py:598-613``)."""
+        cov0 = self.sigma**2
+        cov1 = other.sigma**2
+        mu_delta = other.mu - self.mu
+        trace_cov = jnp.sum(cov0 / cov1)
+        k = self.solution_length
+        scaled_mu = jnp.sum(mu_delta**2 / cov1)
+        log_det = jnp.sum(jnp.log(cov1)) - jnp.sum(jnp.log(cov0))
+        return float(0.5 * (trace_cov - k + scaled_mu + log_det))
+
+
+def _make_class_functional_sample(cls):
+    """Key-splitting batched sampler: batch dims on the parameters produce
+    *independent* noise per batch lane (keys are split in
+    make_functional_sampler, unlike a naive vmap with a broadcast key)."""
+
+    def functional_sample(num_solutions: int, parameters: dict, *, key):
+        return make_functional_sampler(cls)(key, int(num_solutions), parameters)
+
+    return functional_sample
+
+
+class SymmetricSeparableGaussian(SeparableGaussian):
+    """Antithetic separable Gaussian, the PGPE default
+    (reference ``distributions.py:616-773``)."""
+
+    @classmethod
+    def _sample(cls, key, parameters, num_solutions):
+        if num_solutions % 2 != 0:
+            raise ValueError(
+                f"Number of solutions sampled from {cls.__name__} must be even, got {num_solutions}"
+            )
+        mu = parameters["mu"]
+        sigma = parameters["sigma"]
+        num_directions = num_solutions // 2
+        eps = jax.random.normal(key, (num_directions, mu.shape[-1]), dtype=mu.dtype) * sigma
+        # interleaved [mu+e0, mu-e0, mu+e1, mu-e1, ...]
+        pairs = jnp.stack([mu + eps, mu - eps], axis=1)
+        return pairs.reshape(num_solutions, mu.shape[-1])
+
+    @classmethod
+    def _compute_gradients(cls, parameters, samples, weights, ranking_used) -> dict:
+        if "parenthood_ratio" in parameters:
+            return cls._compute_gradients_via_parenthood_ratio(parameters, samples, weights)
+        mu = parameters["mu"]
+        sigma = parameters["sigma"]
+        weights = _zero_center_weights(weights, ranking_used)
+        scaled_noises = samples[0::2] - mu
+        fdplus = weights[0::2]
+        fdminus = weights[1::2]
+        mu_grad = _divide_grad(
+            parameters, "mu", ((fdplus - fdminus) / 2) @ scaled_noises, weights
+        )
+        sigma_grad = _divide_grad(
+            parameters,
+            "sigma",
+            ((fdplus + fdminus) / 2) @ ((scaled_noises**2 - sigma**2) / sigma),
+            weights,
+        )
+        return {"mu": mu_grad, "sigma": sigma_grad}
+
+
+
+
+
+class ExpSeparableGaussian(SeparableGaussian):
+    """Exponential separable Gaussian, as used by SNES
+    (reference ``distributions.py:776-810``)."""
+
+    MANDATORY_PARAMETERS = {"mu", "sigma"}
+    OPTIONAL_PARAMETERS: set = set()
+    PARAMETER_NDIMS = {"mu": 1, "sigma": 1}
+
+    @classmethod
+    def _compute_gradients(cls, parameters, samples, weights, ranking_used) -> dict:
+        if ranking_used != "nes":
+            weights = weights / jnp.sum(jnp.abs(weights))
+        mu = parameters["mu"]
+        sigma = parameters["sigma"]
+        scaled_noises = samples - mu
+        raw_noises = scaled_noises / sigma
+        mu_grad = weights @ scaled_noises
+        sigma_grad = weights @ (raw_noises**2 - 1)
+        return {"mu": mu_grad, "sigma": sigma_grad}
+
+    def update_parameters(self, gradients, *, learning_rates=None, optimizers=None):
+        new_mu = self.mu + self._follow_gradient(
+            "mu", gradients["mu"], learning_rates=learning_rates, optimizers=optimizers
+        )
+        new_sigma = self.sigma * jnp.exp(
+            0.5
+            * self._follow_gradient(
+                "sigma", gradients["sigma"], learning_rates=learning_rates, optimizers=optimizers
+            )
+        )
+        return self.modified_copy(mu=new_mu, sigma=new_sigma)
+
+
+
+
+
+class ExpGaussian(Distribution):
+    """Exponential full-covariance Gaussian, as used by XNES
+    (reference ``distributions.py:813-1016``). ``sigma`` is ``A``, the square
+    root of the covariance; ``sigma_inv`` is tracked independently for
+    numerical stability."""
+
+    MANDATORY_PARAMETERS = {"mu", "sigma"}
+    OPTIONAL_PARAMETERS = {"sigma_inv"}
+    PARAMETER_NDIMS = {"mu": 1, "sigma": 2, "sigma_inv": 2}
+
+    def __init__(self, parameters: dict, *, solution_length: Optional[int] = None, dtype=None, seed=None):
+        parameters = dict(parameters)
+        mu = jnp.asarray(parameters["mu"])
+        sigma = jnp.asarray(parameters["sigma"])
+        if sigma.ndim == 1:
+            sigma = jnp.diag(sigma)
+        parameters["sigma"] = sigma
+        if "sigma_inv" not in parameters:
+            parameters["sigma_inv"] = jnp.linalg.inv(sigma)
+        if solution_length is None:
+            solution_length = mu.shape[-1]
+        elif solution_length != mu.shape[-1]:
+            raise ValueError(
+                f"solution_length={solution_length} does not match len(mu)={mu.shape[-1]}"
+            )
+        if sigma.shape[-1] != mu.shape[-1]:
+            raise ValueError(
+                f"mu and sigma have mismatching lengths: {mu.shape[-1]} vs {sigma.shape[-1]}"
+            )
+        super().__init__(solution_length=solution_length, parameters=parameters, dtype=dtype, seed=seed)
+
+    @property
+    def mu(self) -> jnp.ndarray:
+        return self._parameters["mu"]
+
+    @property
+    def sigma(self) -> jnp.ndarray:
+        return self._parameters["sigma"]
+
+    @property
+    def A(self) -> jnp.ndarray:
+        return self.sigma
+
+    @property
+    def sigma_inv(self) -> jnp.ndarray:
+        return self._parameters["sigma_inv"]
+
+    @property
+    def A_inv(self) -> jnp.ndarray:
+        return self.sigma_inv
+
+    @property
+    def cov(self) -> jnp.ndarray:
+        return self.sigma.T @ self.sigma
+
+    @classmethod
+    def _to_global(cls, parameters, z):
+        # x = mu + A z  (batched: z @ A^T) — reference distributions.py:928
+        return parameters["mu"] + z @ parameters["sigma"].T
+
+    @classmethod
+    def _to_local(cls, parameters, x):
+        # z = A_inv (x - mu) — reference distributions.py:940
+        return (x - parameters["mu"]) @ parameters["sigma_inv"].T
+
+    def to_global_coordinates(self, z: jnp.ndarray) -> jnp.ndarray:
+        return self._to_global(self._parameters, z)
+
+    def to_local_coordinates(self, x: jnp.ndarray) -> jnp.ndarray:
+        return self._to_local(self._parameters, x)
+
+    @classmethod
+    def _sample(cls, key, parameters, num_solutions):
+        mu = parameters["mu"]
+        z = jax.random.normal(key, (num_solutions, mu.shape[-1]), dtype=mu.dtype)
+        return cls._to_global(parameters, z)
+
+    @classmethod
+    def _compute_gradients(cls, parameters, samples, weights, ranking_used) -> dict:
+        z = cls._to_local(parameters, samples)
+        weights = _zero_center_weights(weights, ranking_used)
+        d_grad = weights @ z
+        eye = jnp.eye(z.shape[-1], dtype=z.dtype)
+        outer = z[:, :, None] * z[:, None, :]
+        M_grad = jnp.sum(weights[:, None, None] * (outer - eye), axis=0)
+        return {"d": d_grad, "M": M_grad}
+
+    def update_parameters(self, gradients, *, learning_rates=None, optimizers=None):
+        learning_rates = dict(learning_rates) if learning_rates is not None else {}
+        if "d" not in learning_rates and "mu" in learning_rates:
+            learning_rates["d"] = learning_rates["mu"]
+        if "M" not in learning_rates and "sigma" in learning_rates:
+            learning_rates["M"] = learning_rates["sigma"]
+        update_d = self._follow_gradient("d", gradients["d"], learning_rates=learning_rates, optimizers=optimizers)
+        update_M = self._follow_gradient("M", gradients["M"], learning_rates=learning_rates, optimizers=optimizers)
+        new_mu = self.mu + self.A @ update_d
+        expm = jax.scipy.linalg.expm
+        new_A = self.A @ expm(0.5 * update_M)
+        new_A_inv = expm(-0.5 * update_M) @ self.A_inv
+        return self.modified_copy(mu=new_mu, sigma=new_A, sigma_inv=new_A_inv)
+
+
+
+
+
+# ---------------------------------------------------------------------------
+# Functional factories (reference distributions.py:1023-1623)
+# ---------------------------------------------------------------------------
+
+
+def make_functional_sampler(distribution_class: Type[Distribution]) -> Callable:
+    """Return a stateless, vmap-batchable sampler
+    ``f(key, num_solutions, parameters) -> samples``
+    (reference ``distributions.py:1023-1193`` ``FunctionalSampler``).
+
+    Batch dims on the parameter arrays produce batched sample populations; the
+    key is split across the batch automatically."""
+
+    param_ndims = distribution_class.PARAMETER_NDIMS
+
+    def sampler(key, num_solutions: int, parameters: dict) -> jnp.ndarray:
+        array_params = {
+            k: jnp.asarray(v)
+            for k, v in parameters.items()
+            if k in param_ndims and not isinstance(v, str)
+        }
+        other_params = {k: v for k, v in parameters.items() if k not in array_params}
+        batch_shape = ()
+        for k, v in array_params.items():
+            nd = param_ndims[k]
+            batch_shape = jnp.broadcast_shapes(batch_shape, v.shape[: v.ndim - nd])
+        if batch_shape == ():
+            return distribution_class._sample(key, {**array_params, **other_params}, int(num_solutions))
+
+        import math as _math
+
+        bsize = _math.prod(batch_shape)
+        flat_params = {}
+        for k, v in array_params.items():
+            nd = param_ndims[k]
+            core = v.shape[v.ndim - nd :]
+            flat_params[k] = jnp.broadcast_to(v, batch_shape + core).reshape((bsize,) + core)
+        keys = jax.random.split(key, bsize)
+
+        def one(key, params):
+            return distribution_class._sample(key, {**params, **other_params}, int(num_solutions))
+
+        out = jax.vmap(one)(keys, flat_params)
+        return out.reshape(batch_shape + out.shape[1:])
+
+    sampler.__name__ = f"functional_sampler_of_{distribution_class.__name__}"
+    return sampler
+
+
+def make_functional_grad_estimator(
+    distribution_class: Type[Distribution],
+    *,
+    function: Optional[Callable] = None,
+    objective_sense: str,
+    ranking_method: str = "raw",
+    return_samples: bool = False,
+    return_fitnesses: bool = False,
+) -> Callable:
+    """Return a stateless gradient estimator
+    (reference ``distributions.py:1196-1623`` ``FunctionalGradEstimator``).
+
+    Without ``function``: ``g(samples, fitnesses, parameters) -> grads``.
+    With a bound fitness ``function``: ``g(key, num_solutions, parameters,
+    *fn_args) -> grads`` (samples internally, evaluates, estimates). Extra
+    outputs are appended when ``return_samples``/``return_fitnesses``."""
+
+    higher_is_better = {"max": True, "min": False}[objective_sense]
+    sampler = make_functional_sampler(distribution_class)
+    param_ndims = distribution_class.PARAMETER_NDIMS
+
+    def _estimate(parameters: dict, samples, fitnesses) -> dict:
+        array_params = {
+            k: jnp.asarray(v)
+            for k, v in parameters.items()
+            if k in param_ndims and not isinstance(v, str)
+        }
+        other_params = {k: v for k, v in parameters.items() if k not in array_params}
+        batch_shape = ()
+        for k, v in array_params.items():
+            nd = param_ndims[k]
+            batch_shape = jnp.broadcast_shapes(batch_shape, v.shape[: v.ndim - nd])
+        batch_shape = jnp.broadcast_shapes(batch_shape, jnp.asarray(fitnesses).shape[:-1])
+
+        def one(params, samples, fitnesses):
+            weights = rank(fitnesses, ranking_method, higher_is_better=higher_is_better)
+            return distribution_class._compute_gradients(
+                {**params, **other_params}, samples, weights, ranking_method
+            )
+
+        if batch_shape == ():
+            return one(array_params, jnp.asarray(samples), jnp.asarray(fitnesses))
+
+        import math as _math
+
+        bsize = _math.prod(batch_shape)
+        flat_params = {}
+        for k, v in array_params.items():
+            nd = param_ndims[k]
+            core = v.shape[v.ndim - nd :]
+            flat_params[k] = jnp.broadcast_to(v, batch_shape + core).reshape((bsize,) + core)
+        samples = jnp.asarray(samples)
+        fitnesses = jnp.asarray(fitnesses)
+        samples = jnp.broadcast_to(samples, batch_shape + samples.shape[-2:]).reshape(
+            (bsize,) + samples.shape[-2:]
+        )
+        fitnesses = jnp.broadcast_to(fitnesses, batch_shape + fitnesses.shape[-1:]).reshape(
+            (bsize,) + fitnesses.shape[-1:]
+        )
+        out = jax.vmap(one)(flat_params, samples, fitnesses)
+        return jax.tree_util.tree_map(lambda leaf: leaf.reshape(batch_shape + leaf.shape[1:]), out)
+
+    if function is None:
+
+        def estimator(samples, fitnesses, parameters: dict):
+            return _estimate(parameters, samples, fitnesses)
+
+    else:
+
+        def estimator(key, num_solutions: int, parameters: dict, *fn_args, **fn_kwargs):
+            samples = sampler(key, num_solutions, parameters)
+            fitnesses = function(samples, *fn_args, **fn_kwargs)
+            grads = _estimate(parameters, samples, fitnesses)
+            extras = []
+            if return_samples:
+                extras.append(samples)
+            if return_fitnesses:
+                extras.append(fitnesses)
+            if extras:
+                return (grads, *extras)
+            return grads
+
+    estimator.__name__ = f"functional_grad_estimator_of_{distribution_class.__name__}"
+    return estimator
+
+
+for _cls in (SeparableGaussian, SymmetricSeparableGaussian, ExpSeparableGaussian, ExpGaussian):
+    _cls.functional_sample = staticmethod(_make_class_functional_sample(_cls))
+del _cls

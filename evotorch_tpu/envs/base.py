@@ -1,0 +1,64 @@
+"""Environment protocol for fully-jitted rollouts."""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..tools.pytree import pytree_dataclass, static_field
+
+__all__ = ["Space", "EnvState", "Env"]
+
+
+class Space(NamedTuple):
+    """Box or Discrete space description."""
+
+    shape: tuple
+    lb: Optional[jnp.ndarray] = None  # None for discrete
+    ub: Optional[jnp.ndarray] = None
+    n: Optional[int] = None  # number of actions when discrete
+
+    @property
+    def is_discrete(self) -> bool:
+        return self.n is not None
+
+
+@pytree_dataclass
+class EnvState:
+    """Generic env state: dynamics state + time + PRNG key."""
+
+    obs_state: Any
+    t: jnp.ndarray
+    key: Any
+
+
+class Env:
+    """A pure, jittable environment.
+
+    - ``reset(key) -> (state, obs)``
+    - ``step(state, action) -> (state, obs, reward, done)``
+
+    Both are pure functions of their inputs; vectorization over envs is plain
+    ``jax.vmap``, and auto-reset is implemented by the rollout driver
+    (``neuroevolution.vecneproblem``) with ``jnp.where`` masking."""
+
+    observation_space: Space
+    action_space: Space
+    max_episode_steps: Optional[int] = None
+
+    @property
+    def observation_size(self) -> int:
+        return int(self.observation_space.shape[0])
+
+    @property
+    def action_size(self) -> int:
+        if self.action_space.is_discrete:
+            return int(self.action_space.n)
+        return int(self.action_space.shape[0])
+
+    def reset(self, key) -> Tuple[EnvState, jnp.ndarray]:
+        raise NotImplementedError
+
+    def step(self, state: EnvState, action) -> Tuple[EnvState, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError

@@ -1,0 +1,95 @@
+"""Flat-parameter policy interface.
+
+Parity: reference ``net/functional.py:46-259`` — the
+``ModuleExpectingFlatParameters`` wrapper that turns a network into a pure
+function ``f(flat_params, x, h=None)`` by slicing a flat vector into named
+parameters, and ``make_functional_module`` (``functional.py:203``). Also the
+parameter-vector helpers of ``net/misc.py:26-116``
+(``count_parameters``/``parameter_vector``/``fill_parameters``).
+
+In JAX this is ``ravel_pytree`` rather than meta-device ``functional_call``
+tricks: the unravel function is computed once from the module's parameter
+template and is jit/vmap-transparent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from .layers import Module
+
+__all__ = [
+    "FlatParamsPolicy",
+    "make_functional_module",
+    "count_parameters",
+    "parameter_vector",
+    "fill_parameters",
+]
+
+
+class FlatParamsPolicy:
+    """A network exposed through a flat parameter vector
+    (reference ``ModuleExpectingFlatParameters``, ``net/functional.py:46``).
+
+    Usage::
+
+        policy = FlatParamsPolicy(module, key=jax.random.key(0))
+        flat0 = policy.init_parameters(key)      # (n,) template init
+        y, h  = policy(flat, x)                  # stateless / fresh state
+        y, h  = policy(flat, x, h)               # recurrent step
+    """
+
+    def __init__(self, module: Module, *, key=None):
+        self.module = module
+        template_key = key if key is not None else jax.random.key(0)
+        template = module.init(template_key)
+        flat, unravel = ravel_pytree(template)
+        self._template_flat = flat
+        self._unravel = unravel
+        self.parameter_count = int(flat.shape[0])
+
+    @property
+    def num_parameters(self) -> int:
+        return self.parameter_count
+
+    def init_parameters(self, key) -> jnp.ndarray:
+        """A freshly initialized flat parameter vector."""
+        flat, _ = ravel_pytree(self.module.init(key))
+        return flat
+
+    def unravel(self, flat_params: jnp.ndarray) -> Any:
+        return self._unravel(flat_params)
+
+    def initial_state(self):
+        return self.module.initial_state()
+
+    def __call__(self, flat_params, x, state=None) -> Tuple[jnp.ndarray, Any]:
+        params = self._unravel(flat_params)
+        return self.module.apply(params, x, state)
+
+
+def make_functional_module(module: Module, *, key=None) -> FlatParamsPolicy:
+    """Reference ``net/functional.py:203``."""
+    return FlatParamsPolicy(module, key=key)
+
+
+def count_parameters(module: Module, *, key=None) -> int:
+    """Reference ``net/misc.py:84``."""
+    return FlatParamsPolicy(module, key=key).parameter_count
+
+
+def parameter_vector(params: Any) -> jnp.ndarray:
+    """Flatten a parameter pytree into one vector (reference ``net/misc.py:44``)."""
+    flat, _ = ravel_pytree(params)
+    return flat
+
+
+def fill_parameters(template_params: Any, vector: jnp.ndarray) -> Any:
+    """Inverse of :func:`parameter_vector` against a template pytree
+    (reference ``net/misc.py:26``)."""
+    _, unravel = ravel_pytree(template_params)
+    return unravel(jnp.asarray(vector))

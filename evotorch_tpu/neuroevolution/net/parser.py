@@ -1,0 +1,119 @@
+"""``str_to_net``: the network-specification mini-DSL.
+
+Parity: reference ``net/parser.py:218-344`` (parser internals 88-216): a
+string like ``"Linear(obs_length, 16) >> Tanh() >> Linear(16, act_length)"``
+is parsed via Python ``ast`` into a network. Names are resolved against the
+layer registry (``net/layers.py``); free variables are substituted from
+keyword arguments (the reference's constants mechanism, e.g. ``obs_length`` /
+``act_length`` / ``obs_space`` provided by GymNE-style problems).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from . import layers as _layers
+from .layers import Module, Sequential
+
+__all__ = ["str_to_net", "NetParsingError"]
+
+
+class NetParsingError(Exception):
+    """Parse/eval failure with source context (reference ``parser.py:31-85``)."""
+
+    def __init__(self, message: str, source: str = ""):
+        super().__init__(f"{message}\n  while parsing: {source}" if source else message)
+
+
+_SAFE_FUNCS: Dict[str, Any] = {
+    name: getattr(_layers, name)
+    for name in _layers.__all__
+    if isinstance(getattr(_layers, name), type) and issubclass(getattr(_layers, name), Module)
+}
+# math helpers allowed inside layer arguments
+_SAFE_CONSTS: Dict[str, Any] = {
+    "True": True,
+    "False": False,
+    "None": None,
+    "inf": float("inf"),
+    "nan": float("nan"),
+    "pi": 3.141592653589793,
+}
+
+
+def _eval_node(node: ast.AST, names: Dict[str, Any], source: str) -> Any:
+    if isinstance(node, ast.Expression):
+        return _eval_node(node.body, names, source)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.RShift):
+        left = _eval_node(node.left, names, source)
+        right = _eval_node(node.right, names, source)
+        if not isinstance(left, Module) or not isinstance(right, Module):
+            raise NetParsingError(">> expects layers on both sides", source)
+        return left >> right
+    if isinstance(node, ast.BinOp):
+        left = _eval_node(node.left, names, source)
+        right = _eval_node(node.right, names, source)
+        ops = {
+            ast.Add: lambda a, b: a + b,
+            ast.Sub: lambda a, b: a - b,
+            ast.Mult: lambda a, b: a * b,
+            ast.Div: lambda a, b: a / b,
+            ast.FloorDiv: lambda a, b: a // b,
+            ast.Pow: lambda a, b: a**b,
+            ast.Mod: lambda a, b: a % b,
+        }
+        for op_type, fn in ops.items():
+            if isinstance(node.op, op_type):
+                return fn(left, right)
+        raise NetParsingError(f"Unsupported operator: {ast.dump(node.op)}", source)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return -_eval_node(node.operand, names, source)
+    if isinstance(node, ast.Call):
+        if not isinstance(node.func, ast.Name):
+            raise NetParsingError("Only simple layer names may be called", source)
+        func_name = node.func.id
+        if func_name not in _SAFE_FUNCS:
+            raise NetParsingError(
+                f"Unknown layer type: {func_name!r} (known: {sorted(_SAFE_FUNCS)})", source
+            )
+        func = _SAFE_FUNCS[func_name]
+        args = [_eval_node(a, names, source) for a in node.args]
+        kwargs = {kw.arg: _eval_node(kw.value, names, source) for kw in node.keywords}
+        return func(*args, **kwargs)
+    if isinstance(node, ast.Name):
+        if node.id in names:
+            return names[node.id]
+        if node.id in _SAFE_CONSTS:
+            return _SAFE_CONSTS[node.id]
+        raise NetParsingError(f"Unknown name: {node.id!r}", source)
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [_eval_node(e, names, source) for e in node.elts]
+    raise NetParsingError(f"Unsupported syntax: {ast.dump(node)}", source)
+
+
+def str_to_net(s: str, **constants) -> Module:
+    """Parse a network string into a Module (reference ``parser.py:218``).
+
+    Example::
+
+        net = str_to_net(
+            "Linear(obs_length, 16) >> Tanh() >> Linear(16, act_length)",
+            obs_length=4,
+            act_length=2,
+        )
+    """
+    try:
+        tree = ast.parse(s.strip(), mode="eval")
+    except SyntaxError as e:
+        raise NetParsingError(f"Invalid network string: {e}", s) from e
+    result = _eval_node(tree, dict(constants), s)
+    if not isinstance(result, Module):
+        raise NetParsingError(
+            f"Network string evaluated to {type(result).__name__}, not a layer", s
+        )
+    return result

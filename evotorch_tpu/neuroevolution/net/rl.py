@@ -1,0 +1,119 @@
+"""RL policy-wrapping helpers.
+
+Parity: reference ``net/rl.py`` — ``ActClipWrapperModule`` (``rl.py:130``),
+``ObsNormWrapperModule`` (``rl.py:166``), ``AliveBonusScheduleWrapper``
+(``rl.py:199``), plus the env-step shims ``reset_env``/``take_step_in_env``
+(``rl.py:63-128``) for host-side gymnasium loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import Module
+
+__all__ = [
+    "ObsNormLayer",
+    "ActClipLayer",
+    "ObsNormWrapperModule",
+    "ActClipWrapperModule",
+    "alive_bonus_for_step",
+    "reset_env",
+    "take_step_in_env",
+]
+
+
+class ObsNormLayer(Module):
+    """Frozen observation normalization (reference ``runningnorm.py:to_layer``
+    and ``rl.py:166``)."""
+
+    def __init__(self, *, mean, stdev, clip: Optional[Tuple[float, float]] = None):
+        self.mean = jnp.asarray(mean)
+        self.stdev = jnp.asarray(stdev)
+        self.clip = clip
+
+    def init(self, key):
+        return ()
+
+    def apply(self, params, x, state=None):
+        y = (x - self.mean) / self.stdev
+        if self.clip is not None:
+            y = jnp.clip(y, self.clip[0], self.clip[1])
+        return y, state
+
+    def __repr__(self):
+        return f"ObsNormLayer(n={self.mean.shape[-1]})"
+
+
+class ActClipLayer(Module):
+    """Clip actions into the action space bounds (reference ``rl.py:130``)."""
+
+    def __init__(self, lb, ub):
+        self.lb = jnp.asarray(lb)
+        self.ub = jnp.asarray(ub)
+
+    def init(self, key):
+        return ()
+
+    def apply(self, params, x, state=None):
+        return jnp.clip(x, self.lb, self.ub), state
+
+    def __repr__(self):
+        return "ActClipLayer()"
+
+
+def ObsNormWrapperModule(module: Module, obs_norm) -> Module:
+    """Prepend frozen obs normalization to a policy (reference ``rl.py:166``)."""
+    layer = obs_norm.to_layer() if hasattr(obs_norm, "to_layer") else obs_norm
+    return layer >> module
+
+
+def ActClipWrapperModule(module: Module, lb, ub) -> Module:
+    """Append action clipping to a policy (reference ``rl.py:130``)."""
+    return module >> ActClipLayer(lb, ub)
+
+
+def alive_bonus_for_step(t, alive_bonus_schedule) -> float:
+    """Scheduled alive bonus (reference ``rl.py:199`` and
+    ``vecgymne.py:801-878``): ``(t0, b)`` gives bonus b from timestep t0 on;
+    ``(t0, t1, b)`` ramps linearly from 0 at t0 to b at t1. Works with traced
+    ``t`` inside jit."""
+    if alive_bonus_schedule is None:
+        return 0.0
+    if len(alive_bonus_schedule) == 2:
+        t0, bonus = alive_bonus_schedule
+        return jnp.where(t >= t0, bonus, 0.0)
+    t0, t1, bonus = alive_bonus_schedule
+    ramp = bonus * (t - t0) / max(t1 - t0, 1)
+    return jnp.clip(ramp, 0.0, bonus) * (t >= t0)
+
+
+# --------------------------------------------------------------------------
+# host-side gymnasium shims (classic, non-vectorized API)
+# --------------------------------------------------------------------------
+
+
+def reset_env(env) -> np.ndarray:
+    """Reset a gym(nasium) env under either API generation
+    (reference ``rl.py:63-92``)."""
+    result = env.reset()
+    if isinstance(result, tuple) and len(result) == 2:
+        obs, _info = result
+        return np.asarray(obs)
+    return np.asarray(result)
+
+
+def take_step_in_env(env, action) -> Tuple[np.ndarray, float, bool]:
+    """Step a gym(nasium) env under either API generation; returns
+    ``(obs, reward, done)`` (reference ``rl.py:94-128``)."""
+    result = env.step(np.asarray(action))
+    if len(result) == 5:
+        obs, reward, terminated, truncated, _info = result
+        done = bool(terminated) or bool(truncated)
+    else:
+        obs, reward, done, _info = result
+        done = bool(done)
+    return np.asarray(obs), float(reward), done

@@ -1,0 +1,249 @@
+"""Online observation normalization with mergeable statistics.
+
+Parity: reference ``net/runningnorm.py:47-621`` (device-aware running
+mean/stdev with masked updates and ``to_layer()``) and
+``net/runningstat.py:25-152`` (the numpy Welford-style counterpart used for
+actor-delta sync).
+
+TPU-first design: the statistics are a *pytree* ``(count, sum, sum_of_squares)``
+— a ``CollectedStats`` dataclass — so they can
+
+- ride inside a jitted ``lax.scan`` rollout (the reference updates stats
+  statefully in Python between env steps; here they are part of the scan
+  carry, SURVEY.md §7 hard-parts),
+- merge across mesh shards with a single ``psum`` (the reference's
+  main<->actor delta-sync protocol, ``gymne.py:524-573``, collapses to a
+  collective).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tools.pytree import pytree_dataclass, replace
+
+__all__ = ["CollectedStats", "RunningNorm", "RunningStat"]
+
+
+@pytree_dataclass
+class CollectedStats:
+    count: jnp.ndarray  # scalar
+    sum: jnp.ndarray  # (n,)
+    sum_of_squares: jnp.ndarray  # (n,)
+
+    @property
+    def mean(self) -> jnp.ndarray:
+        return self.sum / jnp.maximum(self.count, 1.0)
+
+    @property
+    def stdev(self) -> jnp.ndarray:
+        c = jnp.maximum(self.count, 2.0)
+        var = (self.sum_of_squares - (self.sum**2) / c) / (c - 1.0)
+        return jnp.sqrt(jnp.maximum(var, 1e-8))
+
+
+def _stats_init(n: int, dtype=jnp.float32) -> CollectedStats:
+    return CollectedStats(
+        count=jnp.zeros((), dtype=dtype),
+        sum=jnp.zeros(n, dtype=dtype),
+        sum_of_squares=jnp.zeros(n, dtype=dtype),
+    )
+
+
+def stats_update(stats: CollectedStats, obs: jnp.ndarray, mask: Optional[jnp.ndarray] = None) -> CollectedStats:
+    """Accumulate a batch of observations ``(B, n)``; rows where ``mask`` is
+    False are ignored (reference masked update, ``runningnorm.py:300-380``).
+    Pure function — usable inside jit/scan."""
+    obs = jnp.atleast_2d(obs)
+    if mask is not None:
+        m = mask[:, None].astype(obs.dtype)
+        obs = obs * m
+        n_new = jnp.sum(mask.astype(obs.dtype))
+    else:
+        n_new = jnp.asarray(obs.shape[0], dtype=obs.dtype)
+    return CollectedStats(
+        count=stats.count + n_new,
+        sum=stats.sum + jnp.sum(obs, axis=0),
+        sum_of_squares=stats.sum_of_squares + jnp.sum(obs**2, axis=0),
+    )
+
+
+def stats_merge(a: CollectedStats, b: CollectedStats) -> CollectedStats:
+    """Merge two stats (the reference's ``update(other)``,
+    ``runningstat.py:76``); equals elementwise addition, which is why a psum
+    across shards is the distributed merge."""
+    return CollectedStats(
+        count=a.count + b.count,
+        sum=a.sum + b.sum,
+        sum_of_squares=a.sum_of_squares + b.sum_of_squares,
+    )
+
+
+def stats_psum(stats: CollectedStats, axis_name: str) -> CollectedStats:
+    """All-reduce the stats across a mesh axis (inside shard_map)."""
+    return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, axis_name), stats)
+
+
+def stats_normalize(stats: CollectedStats, obs: jnp.ndarray, *, clip: Optional[Tuple[float, float]] = None) -> jnp.ndarray:
+    """Normalize observations by the collected stats; identity while count<2."""
+    safe = stats.count >= 2
+    normalized = (obs - stats.mean) / stats.stdev
+    if clip is not None:
+        lo, hi = clip
+        normalized = jnp.clip(normalized, lo, hi)
+    return jnp.where(safe, normalized, obs)
+
+
+class RunningNorm:
+    """Stateful convenience wrapper over the pure stats functions
+    (reference ``net/runningnorm.py:47``)."""
+
+    def __init__(self, shape, dtype=jnp.float32, *, min_variance: float = 1e-8, clip: Optional[Tuple[float, float]] = None):
+        if isinstance(shape, int):
+            shape = (shape,)
+        (self._n,) = tuple(shape)
+        self._dtype = dtype
+        self._min_variance = float(min_variance)
+        self._clip = clip
+        self.stats = _stats_init(self._n, dtype)
+
+    @property
+    def shape(self):
+        return (self._n,)
+
+    @property
+    def count(self) -> float:
+        return float(self.stats.count)
+
+    @property
+    def mean(self) -> jnp.ndarray:
+        return self.stats.mean
+
+    @property
+    def stdev(self) -> jnp.ndarray:
+        return self.stats.stdev
+
+    def update(self, x, mask=None):
+        """Accumulate an observation (1-D) or a batch (2-D); or merge another
+        RunningNorm/RunningStat/CollectedStats."""
+        if isinstance(x, RunningNorm):
+            self.stats = stats_merge(self.stats, x.stats)
+        elif isinstance(x, CollectedStats):
+            self.stats = stats_merge(self.stats, x)
+        elif isinstance(x, RunningStat):
+            other = CollectedStats(
+                count=jnp.asarray(float(x.count), dtype=self._dtype),
+                sum=jnp.asarray(x.sum, dtype=self._dtype),
+                sum_of_squares=jnp.asarray(x.sum_of_squares, dtype=self._dtype),
+            )
+            self.stats = stats_merge(self.stats, other)
+        else:
+            x = jnp.asarray(x, dtype=self._dtype)
+            if x.ndim == 1:
+                x = x[None, :]
+            self.stats = stats_update(self.stats, x, mask)
+
+    def normalize(self, x) -> jnp.ndarray:
+        return stats_normalize(self.stats, jnp.asarray(x, dtype=self._dtype), clip=self._clip)
+
+    def __call__(self, x) -> jnp.ndarray:
+        return self.normalize(x)
+
+    def update_and_normalize(self, x, mask=None) -> jnp.ndarray:
+        self.update(x, mask)
+        return self.normalize(x)
+
+    def to_layer(self):
+        """Freeze into an ObsNormLayer-style module (reference
+        ``runningnorm.py:580-621``)."""
+        from .rl import ObsNormLayer
+
+        return ObsNormLayer(mean=self.mean, stdev=self.stdev, clip=self._clip)
+
+    def reset(self):
+        self.stats = _stats_init(self._n, self._dtype)
+
+    def __repr__(self):
+        return f"RunningNorm(shape={self.shape}, count={self.count})"
+
+
+class RunningStat:
+    """Host-side numpy counterpart (reference ``net/runningstat.py:25-152``),
+    kept for non-jitted (classic gym) rollouts. Mergeable via ``update``."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._count = 0
+        self._sum: Optional[np.ndarray] = None
+        self._sum_of_squares: Optional[np.ndarray] = None
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> np.ndarray:
+        return self._sum
+
+    @property
+    def sum_of_squares(self) -> np.ndarray:
+        return self._sum_of_squares
+
+    @property
+    def mean(self) -> np.ndarray:
+        return self._sum / self._count
+
+    @property
+    def stdev(self) -> np.ndarray:
+        c = max(self._count, 2)
+        var = (self._sum_of_squares - (self._sum**2) / c) / (c - 1)
+        return np.sqrt(np.maximum(var, 1e-8))
+
+    def update(self, x):
+        if isinstance(x, RunningStat):
+            if x._count == 0:
+                return
+            if self._count == 0:
+                self._count = x._count
+                self._sum = x._sum.copy()
+                self._sum_of_squares = x._sum_of_squares.copy()
+            else:
+                self._count += x._count
+                self._sum = self._sum + x._sum
+                self._sum_of_squares = self._sum_of_squares + x._sum_of_squares
+            return
+        x = np.asarray(x, dtype=np.float64)
+        if x.ndim == 1:
+            x = x[None, :]
+        if self._count == 0:
+            self._sum = np.zeros(x.shape[-1], dtype=np.float64)
+            self._sum_of_squares = np.zeros(x.shape[-1], dtype=np.float64)
+        self._count += x.shape[0]
+        self._sum += x.sum(axis=0)
+        self._sum_of_squares += (x**2).sum(axis=0)
+
+    def normalize(self, x) -> np.ndarray:
+        if self._count < 2:
+            return np.asarray(x)
+        return (np.asarray(x) - self.mean) / self.stdev
+
+    def to_delta(self, since: "RunningStat") -> "RunningStat":
+        """Stats collected since ``since`` (the actor-delta of the reference's
+        sync protocol, ``gymne.py:548-573``)."""
+        delta = RunningStat()
+        if self._count > since._count:
+            delta._count = self._count - since._count
+            delta._sum = self._sum - (since._sum if since._sum is not None else 0.0)
+            delta._sum_of_squares = self._sum_of_squares - (
+                since._sum_of_squares if since._sum_of_squares is not None else 0.0
+            )
+        return delta
+
+    def __repr__(self):
+        return f"RunningStat(count={self._count})"

@@ -1,0 +1,5 @@
+"""Variation operators (L5')."""
+
+from . import functional
+
+__all__ = ["functional"]

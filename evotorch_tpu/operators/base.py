@@ -1,0 +1,128 @@
+"""OO operator bases.
+
+Parity: reference ``operators/base.py`` — ``Operator``/``CopyingOperator``
+(``base.py:27-154``) and ``CrossOver`` with vectorized tournament selection
+(``base.py:157-412``). The OO operators are thin, PRNG-threading wrappers over
+``operators.functional``; the math lives there.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import Problem, SolutionBatch
+from ..tools.misc import clip_tensor
+from . import functional as F
+
+__all__ = ["Operator", "CopyingOperator", "SingleObjOperator", "CrossOver"]
+
+
+class Operator:
+    """Base class: a callable acting on a SolutionBatch
+    (reference ``base.py:27``)."""
+
+    def __init__(self, problem: Problem):
+        self._problem = problem
+
+    @property
+    def problem(self) -> Problem:
+        return self._problem
+
+    @property
+    def dtype(self):
+        return self._problem.dtype
+
+    def _respect_bounds(self, values: jnp.ndarray) -> jnp.ndarray:
+        """Clip to the problem's strict bounds if any (reference ``base.py:109``)."""
+        return clip_tensor(values, self._problem.lower_bounds, self._problem.upper_bounds)
+
+    def __call__(self, batch: SolutionBatch):
+        raise NotImplementedError
+
+
+class CopyingOperator(Operator):
+    """Operator producing a new batch instead of mutating in place
+    (reference ``base.py:120``)."""
+
+    def __call__(self, batch: SolutionBatch) -> SolutionBatch:
+        return self._do(batch)
+
+    def _do(self, batch: SolutionBatch) -> SolutionBatch:
+        raise NotImplementedError
+
+
+class SingleObjOperator(Operator):
+    """Marker base for operators valid only on single-objective problems."""
+
+    def __init__(self, problem: Problem):
+        if problem.is_multi_objective:
+            raise ValueError(f"{type(self).__name__} supports single-objective problems only")
+        super().__init__(problem)
+
+
+class CrossOver(CopyingOperator):
+    """Base for crossover operators with built-in tournament selection
+    (reference ``base.py:157-412``: utilities are centered ranks in the
+    single-objective case, pareto utilities in MOO)."""
+
+    def __init__(
+        self,
+        problem: Problem,
+        *,
+        tournament_size: int,
+        obj_index: Optional[int] = None,
+        num_children: Optional[int] = None,
+        cross_over_rate: Optional[float] = None,
+    ):
+        super().__init__(problem)
+        self._tournament_size = int(tournament_size)
+        self._obj_index = None if obj_index is None else problem.normalize_obj_index(obj_index)
+        if num_children is not None and cross_over_rate is not None:
+            raise ValueError("Provide at most one of num_children / cross_over_rate")
+        self._num_children = None if num_children is None else int(num_children)
+        self._cross_over_rate = None if cross_over_rate is None else float(cross_over_rate)
+
+    def _resolve_num_children(self, batch: SolutionBatch) -> int:
+        if self._num_children is not None:
+            n = self._num_children
+        elif self._cross_over_rate is not None:
+            n = int(len(batch) * self._cross_over_rate)
+        else:
+            n = len(batch)
+        if n % 2 != 0:
+            n += 1
+        return n
+
+    def _do_tournament(self, batch: SolutionBatch):
+        """Pick two parent sets via tournament (reference ``base.py:263-365``)."""
+        num_children = self._resolve_num_children(batch)
+        problem = self._problem
+        if problem.is_multi_objective and self._obj_index is None:
+            objective_sense = problem.senses
+            evals = batch.evals[:, : problem.num_objectives]
+        else:
+            i = 0 if self._obj_index is None else self._obj_index
+            objective_sense = problem.senses[i]
+            evals = batch.evals[:, i]
+        return F.tournament(
+            problem.next_rng_key(),
+            batch.values,
+            evals,
+            num_tournaments=num_children,
+            tournament_size=self._tournament_size,
+            objective_sense=objective_sense,
+            split_results=True,
+        )
+
+    def _do_cross_over(self, parents1, parents2) -> SolutionBatch:
+        raise NotImplementedError
+
+    def _do(self, batch: SolutionBatch) -> SolutionBatch:
+        parents1, parents2 = self._do_tournament(batch)
+        return self._do_cross_over(parents1, parents2)
+
+    def _make_children_batch(self, child_values) -> SolutionBatch:
+        child_values = self._respect_bounds(child_values)
+        return SolutionBatch(self._problem, child_values.shape[0], values=child_values)

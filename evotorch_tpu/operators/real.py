@@ -1,0 +1,173 @@
+"""OO operators on real-valued decision vectors.
+
+Parity: reference ``operators/real.py`` — ``GaussianMutation``
+(``real.py:30-66``), ``MultiPointCrossOver``/``OnePoint``/``TwoPoint``
+(``real.py:69-389``), ``SimulatedBinaryCrossOver`` (``real.py:391-482``),
+``PolynomialMutation`` (``real.py:484-604``), ``CosynePermutation``
+(``real.py:606-706``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import Problem, SolutionBatch
+from . import functional as F
+from .base import CopyingOperator, CrossOver
+
+__all__ = [
+    "GaussianMutation",
+    "MultiPointCrossOver",
+    "OnePointCrossOver",
+    "TwoPointCrossOver",
+    "SimulatedBinaryCrossOver",
+    "PolynomialMutation",
+    "CosynePermutation",
+]
+
+
+class GaussianMutation(CopyingOperator):
+    """Additive Gaussian noise (reference ``real.py:30-66``)."""
+
+    def __init__(self, problem: Problem, *, stdev: float, mutation_probability: Optional[float] = None):
+        super().__init__(problem)
+        self._stdev = float(stdev)
+        self._mutation_probability = mutation_probability
+
+    def _do(self, batch: SolutionBatch) -> SolutionBatch:
+        mutated = F.gaussian_mutation(
+            self._problem.next_rng_key(),
+            batch.values,
+            stdev=self._stdev,
+            mutation_probability=self._mutation_probability,
+        )
+        return SolutionBatch(
+            self._problem, mutated.shape[0], values=self._respect_bounds(mutated)
+        )
+
+
+class MultiPointCrossOver(CrossOver):
+    """k-point crossover (reference ``real.py:69-389``)."""
+
+    def __init__(
+        self,
+        problem: Problem,
+        *,
+        tournament_size: int,
+        num_points: int,
+        obj_index: Optional[int] = None,
+        num_children: Optional[int] = None,
+        cross_over_rate: Optional[float] = None,
+    ):
+        super().__init__(
+            problem,
+            tournament_size=tournament_size,
+            obj_index=obj_index,
+            num_children=num_children,
+            cross_over_rate=cross_over_rate,
+        )
+        self._num_points = int(num_points)
+        if self._num_points < 1:
+            raise ValueError(f"num_points must be >= 1, got {num_points}")
+
+    def _do_cross_over(self, parents1, parents2) -> SolutionBatch:
+        parents = jnp.concatenate([parents1, parents2], axis=0)
+        children = F.multi_point_cross_over(
+            self._problem.next_rng_key(), parents, num_points=self._num_points
+        )
+        return self._make_children_batch(children)
+
+
+class OnePointCrossOver(MultiPointCrossOver):
+    def __init__(self, problem: Problem, *, tournament_size: int, obj_index=None, num_children=None, cross_over_rate=None):
+        super().__init__(
+            problem, tournament_size=tournament_size, num_points=1,
+            obj_index=obj_index, num_children=num_children, cross_over_rate=cross_over_rate,
+        )
+
+
+class TwoPointCrossOver(MultiPointCrossOver):
+    def __init__(self, problem: Problem, *, tournament_size: int, obj_index=None, num_children=None, cross_over_rate=None):
+        super().__init__(
+            problem, tournament_size=tournament_size, num_points=2,
+            obj_index=obj_index, num_children=num_children, cross_over_rate=cross_over_rate,
+        )
+
+
+class SimulatedBinaryCrossOver(CrossOver):
+    """SBX (reference ``real.py:391-482``)."""
+
+    def __init__(
+        self,
+        problem: Problem,
+        *,
+        tournament_size: int,
+        eta: float,
+        obj_index: Optional[int] = None,
+        num_children: Optional[int] = None,
+        cross_over_rate: Optional[float] = None,
+    ):
+        super().__init__(
+            problem,
+            tournament_size=tournament_size,
+            obj_index=obj_index,
+            num_children=num_children,
+            cross_over_rate=cross_over_rate,
+        )
+        self._eta = float(eta)
+
+    def _do_cross_over(self, parents1, parents2) -> SolutionBatch:
+        parents = jnp.concatenate([parents1, parents2], axis=0)
+        children = F.simulated_binary_cross_over(
+            self._problem.next_rng_key(), parents, eta=self._eta
+        )
+        return self._make_children_batch(children)
+
+
+class PolynomialMutation(CopyingOperator):
+    """Bounded polynomial mutation (reference ``real.py:484-604``)."""
+
+    def __init__(self, problem: Problem, *, eta: Optional[float] = None, mutation_probability: Optional[float] = None):
+        super().__init__(problem)
+        if problem.lower_bounds is None or problem.upper_bounds is None:
+            raise ValueError("PolynomialMutation requires a bounded problem")
+        self._eta = 20.0 if eta is None else float(eta)
+        self._mutation_probability = mutation_probability
+
+    def _do(self, batch: SolutionBatch) -> SolutionBatch:
+        mutated = F.polynomial_mutation(
+            self._problem.next_rng_key(),
+            batch.values,
+            lb=self._problem.lower_bounds,
+            ub=self._problem.upper_bounds,
+            eta=self._eta,
+            mutation_probability=self._mutation_probability,
+        )
+        return SolutionBatch(self._problem, mutated.shape[0], values=mutated)
+
+
+class CosynePermutation(CopyingOperator):
+    """Rank-biased per-column permutation (reference ``real.py:606-706``)."""
+
+    def __init__(self, problem: Problem, obj_index: Optional[int] = None, *, permute_all: bool = False):
+        super().__init__(problem)
+        self._permute_all = bool(permute_all)
+        self._obj_index = problem.normalize_obj_index(obj_index) if not permute_all else None
+
+    def _do(self, batch: SolutionBatch) -> SolutionBatch:
+        if self._permute_all:
+            permuted = F.cosyne_permutation(
+                self._problem.next_rng_key(), batch.values, permute_all=True
+            )
+        else:
+            i = self._obj_index
+            permuted = F.cosyne_permutation(
+                self._problem.next_rng_key(),
+                batch.values,
+                batch.evals[:, i],
+                permute_all=False,
+                objective_sense=self._problem.senses[i],
+            )
+        return SolutionBatch(self._problem, permuted.shape[0], values=permuted)

@@ -1,0 +1,39 @@
+"""Operators for variable-length (object-dtype) solutions.
+
+Parity: reference ``operators/sequence.py`` — ``CutAndSplice``
+(``sequence.py:25-74``): one-point crossover for sequences of differing
+lengths, host-side (object-dtype populations never touch the device).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import Problem, SolutionBatch
+from ..tools.objectarray import ObjectArray
+from .base import CrossOver
+
+__all__ = ["CutAndSplice"]
+
+
+class CutAndSplice(CrossOver):
+    """Cut-and-splice crossover on object-dtype (sequence) solutions
+    (reference ``sequence.py:25-74``)."""
+
+    def _do_cross_over(self, parents1, parents2) -> SolutionBatch:
+        n = len(parents1)
+        children = ObjectArray(2 * n)
+        rng = np.random.default_rng(
+            np.asarray(
+                __import__("jax").random.key_data(self._problem.next_rng_key())
+            ).ravel()
+        )
+        for i in range(n):
+            a = list(parents1[i])
+            b = list(parents2[i])
+            cut_a = int(rng.integers(0, len(a) + 1))
+            cut_b = int(rng.integers(0, len(b) + 1))
+            children[i] = a[:cut_a] + b[cut_b:]
+            children[n + i] = b[:cut_b] + a[cut_a:]
+        batch = SolutionBatch(self._problem, len(children), values=children)
+        return batch

@@ -1,0 +1,32 @@
+"""Parallel execution layer (L3): SPMD over the TPU device mesh.
+
+This module replaces the reference's entire Ray actor layer
+(``core.py:115-356`` ``EvaluationActor``, ``core.py:1977-2052``
+``Problem._parallelize`` + ``ActorPool``, ``core.py:2762-3073`` distributed
+gradient sampling, and the main<->actor sync protocol ``core.py:2239-2332``)
+with XLA collectives over a ``jax.sharding.Mesh``:
+
+- population evaluation  -> ``shard_map`` over the population axis
+  (one program, population rows sharded across devices via ICI);
+- ES-gradient estimation -> local sample/evaluate/rank/grad per shard,
+  then ``pmean`` (this *is* the reference's weighted average of per-actor
+  gradients, ``gaussian.py:246-271``, expressed as a collective);
+- obs-norm stat merging  -> ``psum`` of (count, sum, sumsq) — see
+  ``neuroevolution.net.runningnorm``;
+- multi-host             -> ``jax.distributed.initialize`` over DCN.
+"""
+
+from .mesh import default_mesh, device_count, make_mesh
+from .evaluate import make_sharded_evaluator, shard_population
+from .grad import make_sharded_grad_estimator
+from .distributed import init_distributed
+
+__all__ = [
+    "default_mesh",
+    "device_count",
+    "make_mesh",
+    "make_sharded_evaluator",
+    "shard_population",
+    "make_sharded_grad_estimator",
+    "init_distributed",
+]

@@ -1,0 +1,46 @@
+"""Multi-host (DCN) initialization.
+
+The reference documents cluster attach via ``ray start --head`` +
+``ray.init(address=...)`` (``docs/advanced_usage/ray_cluster.md:1-40``). The
+TPU-native equivalent is ``jax.distributed.initialize``: after it, every host
+sees the global device set and the same SPMD programs (shard_map/pjit) span
+hosts, with collectives riding ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["init_distributed"]
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    *,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize multi-host JAX if the environment calls for it.
+
+    With no arguments, initialization is attempted only when the standard
+    cluster environment variables are present (e.g. on Cloud TPU pods, GKE
+    with the JAX plugin, or SLURM); single-host runs return False untouched.
+    """
+    already = getattr(jax.distributed, "is_initialized", None)
+    if callable(already) and jax.distributed.is_initialized():
+        return True
+    if coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    cluster_hints = ("COORDINATOR_ADDRESS", "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS")
+    if any(h in os.environ for h in cluster_hints):
+        jax.distributed.initialize()
+        return True
+    return False

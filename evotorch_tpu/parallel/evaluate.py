@@ -1,0 +1,72 @@
+"""Sharded population evaluation.
+
+Replaces the reference's actor-pool fitness evaluation
+(``core.py:2573-2600``: split batch -> ``ActorPool.map_unordered`` ->
+scatter-back) with a single jitted ``shard_map``: the ``(N, L)`` population is
+sharded along the mesh's population axis, each device evaluates its rows
+locally, and the sharded result is reassembled by XLA — no pickling, no RPC.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import default_mesh
+
+__all__ = ["make_sharded_evaluator", "shard_population"]
+
+
+def shard_population(values: jnp.ndarray, mesh: Optional[Mesh] = None, axis_name: str = "pop") -> jnp.ndarray:
+    """Place a population array so its leading (population) axis is sharded
+    over the mesh — rows live distributed in HBM across devices."""
+    if mesh is None:
+        mesh = default_mesh((axis_name,))
+    return jax.device_put(values, NamedSharding(mesh, P(axis_name)))
+
+
+def make_sharded_evaluator(
+    fitness_func: Callable,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "pop",
+) -> Callable:
+    """Wrap a vectorized fitness function ``f(values (n,L)) -> (n,) | (n,K)``
+    into a jitted evaluator that shards the population axis over the mesh.
+
+    Populations whose size is not divisible by the mesh axis are padded with
+    their first row and the padding results are discarded (the analog of the
+    reference's uneven ``split_workload``, ``tools/misc.py:1113``).
+    """
+    if mesh is None:
+        mesh = default_mesh((axis_name,))
+    n_shards = mesh.shape[axis_name]
+
+    def local_eval(values_shard):
+        return fitness_func(values_shard)
+
+    @jax.jit
+    def evaluator(values):
+        n = values.shape[0]
+        padded_n = -(-n // n_shards) * n_shards
+        if padded_n != n:
+            pad = jnp.broadcast_to(values[:1], (padded_n - n,) + values.shape[1:])
+            padded = jnp.concatenate([values, pad], axis=0)
+        else:
+            padded = values
+
+        out_struct = jax.eval_shape(fitness_func, padded)
+        out_specs = jax.tree_util.tree_map(lambda _: P(axis_name), out_struct)
+        result = jax.shard_map(
+            local_eval,
+            mesh=mesh,
+            in_specs=P(axis_name),
+            out_specs=out_specs,
+            check_vma=False,
+        )(padded)
+        return jax.tree_util.tree_map(lambda r: r[:n], result)
+
+    return evaluator

@@ -1,0 +1,89 @@
+"""Sharded ES-gradient estimation: the TPU form of the reference's
+distributed mode.
+
+Reference behavior (``core.py:2762-3073`` + ``gaussian.py:199-272``): each Ray
+actor samples its own sub-population from the (broadcast) distribution,
+evaluates it, ranks *locally*, computes local gradients, and the main process
+averages the per-actor gradients weighted by sub-population size. Here the
+same dataflow is one SPMD program: each mesh shard samples ``popsize/shards``
+solutions with a device-unique key, evaluates and ranks locally, computes
+local gradients, and a ``pmean`` over the population axis produces the
+(equal-weight, since shards are equal-sized) average on every device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Type
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..tools.ranking import rank
+from .mesh import default_mesh
+
+__all__ = ["make_sharded_grad_estimator"]
+
+
+def make_sharded_grad_estimator(
+    distribution_class: Type,
+    fitness_func: Callable,
+    *,
+    objective_sense: str,
+    ranking_method: str = "centered",
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "pop",
+) -> Callable:
+    """Build ``g(key, num_solutions, parameters) -> grads`` where the
+    sample/evaluate/rank/grad pipeline runs sharded over the mesh and the
+    returned gradient dict is the pmean across shards (replicated on all
+    devices).
+
+    ``num_solutions`` is the *global* population size and must be divisible by
+    the mesh axis size (and the local size must be even for symmetric
+    distributions)."""
+    if mesh is None:
+        mesh = default_mesh((axis_name,))
+    n_shards = mesh.shape[axis_name]
+    higher_is_better = {"max": True, "min": False}[objective_sense]
+
+    def estimator(key, num_solutions: int, parameters: dict):
+        num_solutions = int(num_solutions)
+        if num_solutions % n_shards != 0:
+            raise ValueError(
+                f"num_solutions={num_solutions} must be divisible by the mesh axis size {n_shards}"
+            )
+        local_popsize = num_solutions // n_shards
+
+        # strings ("divide_mu_grad_by", ...) and structural floats
+        # ("parenthood_ratio") are not JAX types: close over them statically
+        static_params = {
+            k: v
+            for k, v in parameters.items()
+            if isinstance(v, str) or k == "parenthood_ratio"
+        }
+        array_params = {k: v for k, v in parameters.items() if k not in static_params}
+
+        def local(key, array_params):
+            parameters = {**array_params, **static_params}
+            my_key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+            samples = distribution_class._sample(my_key, parameters, local_popsize)
+            fitnesses = fitness_func(samples)
+            weights = rank(fitnesses, ranking_method, higher_is_better=higher_is_better)
+            grads = distribution_class._compute_gradients(
+                parameters, samples, weights, ranking_method
+            )
+            return jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, axis_name), grads
+            )
+
+        sharded = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return sharded(key, array_params)
+
+    return estimator

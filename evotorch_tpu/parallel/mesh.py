@@ -1,0 +1,45 @@
+"""Device-mesh helpers.
+
+The mesh is the TPU analog of the reference's actor pool size
+(``num_actors``, reference ``core.py:1302-1595``): instead of asking "how many
+Ray actors", you ask "which mesh axes". The default is a 1-D mesh named
+``"pop"`` over all local devices, used to shard the population axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["default_mesh", "make_mesh", "device_count"]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def default_mesh(axis_names: Sequence[str] = ("pop",), devices=None) -> Mesh:
+    """1-D mesh over all (or the given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if len(axis_names) != 1:
+        raise ValueError("default_mesh creates 1-D meshes; use make_mesh for N-D")
+    return Mesh(np.asarray(devices), axis_names=tuple(axis_names))
+
+
+def make_mesh(axis_shape: dict, devices=None) -> Mesh:
+    """N-D mesh from ``{axis_name: size}``; e.g.
+    ``make_mesh({"pop": 4, "model": 2})`` lays population-parallel shards over
+    4 device groups with 2-way model sharding inside each."""
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axis_shape.keys())
+    shape = tuple(int(s) for s in axis_shape.values())
+    total = int(np.prod(shape))
+    if total > len(devices):
+        raise ValueError(f"Mesh needs {total} devices, but only {len(devices)} are available")
+    grid = np.asarray(devices[:total]).reshape(shape)
+    return Mesh(grid, axis_names=names)

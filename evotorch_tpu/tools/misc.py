@@ -158,6 +158,8 @@ def ensure_array_length_and_dtype(
     For ``dtype=object`` the result is a host-side :class:`ObjectArray`."""
     d = to_jax_dtype(dtype)
     if d is object:
+        from collections.abc import Mapping
+
         from .objectarray import ObjectArray
 
         if isinstance(x, ObjectArray):
@@ -166,9 +168,16 @@ def ensure_array_length_and_dtype(
                     f"{about or 'value'}: expected length {length}, got {len(x)}"
                 )
             return x
-        values = list(x) if not isinstance(x, (str, bytes)) else [x]
-        if len(values) == 1 and length != 1 and allow_scalar:
-            values = values * length
+        # strings, mappings, and non-iterables count as single object payloads
+        is_scalar_payload = isinstance(x, (str, bytes, Mapping)) or not hasattr(x, "__iter__")
+        if is_scalar_payload:
+            if not allow_scalar and length != 1:
+                raise ValueError(f"{about or 'value'}: expected a sequence, got {x!r}")
+            values = [x] * length
+        else:
+            values = list(x)
+            if len(values) == 1 and length != 1 and allow_scalar:
+                values = values * length
         if len(values) != length:
             raise ValueError(
                 f"{about or 'value'}: expected length {length}, got {len(values)}"

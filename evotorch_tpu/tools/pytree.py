@@ -1,0 +1,35 @@
+"""Pytree-dataclass helpers for algorithm states.
+
+The reference's functional states are ``NamedTuple``s of torch tensors
+(``funccem.py:24``, ``funcpgpe.py:54``); here they are frozen dataclasses
+registered as JAX pytrees, with hyper-flags (optimizer name, ranking method,
+objective sense, ...) marked *static* so whole states pass through ``jit`` /
+``vmap`` / ``lax.scan`` unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+__all__ = ["pytree_dataclass", "static_field", "field", "replace"]
+
+
+def static_field(**kwargs):
+    """A dataclass field excluded from pytree leaves (compile-time constant)."""
+    metadata = dict(kwargs.pop("metadata", {}))
+    metadata["static"] = True
+    return dataclasses.field(metadata=metadata, **kwargs)
+
+
+def field(**kwargs):
+    return dataclasses.field(**kwargs)
+
+
+def pytree_dataclass(cls):
+    """Decorator: frozen dataclass registered as a JAX pytree node."""
+    return jax.tree_util.register_dataclass(dataclasses.dataclass(frozen=True)(cls))
+
+
+replace = dataclasses.replace

@@ -106,7 +106,9 @@ class TensorMakerMixin:
                 raise ValueError(f"symmetric gaussian requires an even leading dimension, got shape {shape}")
             half = (shape[0] // 2,) + shape[1:]
             eps = jax.random.normal(key, half, dtype=dtype)
-            noise = jnp.concatenate([eps, -eps], axis=0)
+            # interleave antithetic pairs: [+e0, -e0, +e1, -e1, ...]
+            # (reference distributions.py:649-668 direction layout)
+            noise = jnp.stack([eps, -eps], axis=1).reshape(shape)
         else:
             noise = jax.random.normal(key, shape, dtype=dtype)
         if stdev is not None:

@@ -1,0 +1,20 @@
+"""Shared test helpers: run functional ask/tell searches as one jitted scan."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("ask", "tell", "fitness", "popsize", "num_generations"))
+def run_functional_search(state, key, *, ask, tell, fitness, popsize, num_generations):
+    """Run `num_generations` of ask/eval/tell inside one lax.scan."""
+
+    def gen(state, key):
+        pop = ask(key, state, popsize=popsize)
+        fits = fitness(pop)
+        state = tell(state, pop, fits)
+        return state, jnp.mean(fits)
+
+    keys = jax.random.split(key, num_generations)
+    return jax.lax.scan(gen, state, keys)

@@ -1,0 +1,176 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu import Problem, vectorized
+from evotorch_tpu.algorithms.cmaes import CMAES
+from evotorch_tpu.algorithms.ga import Cosyne, GeneticAlgorithm, SteadyStateGA
+from evotorch_tpu.algorithms.gaussian import CEM, PGPE, SNES, XNES
+from evotorch_tpu.operators.real import GaussianMutation, OnePointCrossOver, SimulatedBinaryCrossOver
+
+
+@vectorized
+def sphere(xs):
+    return jnp.sum(xs**2, axis=-1)
+
+
+def make_problem(n=10, seed=1):
+    return Problem("min", sphere, solution_length=n, initial_bounds=(-5, 5), seed=seed)
+
+
+def improvement(searcher, gens=30):
+    searcher.step()
+    first = searcher.status["mean_eval"]
+    searcher.run(gens)
+    return first, searcher.status["mean_eval"]
+
+
+# ------------------------------------------------------ quickstart parity ---
+# reference test_examples.py:29-60 parametrizes the sphere problem over every
+# algorithm for a few generations (smoke-level convergence)
+
+
+def test_snes_improves():
+    first, last = improvement(SNES(make_problem(), stdev_init=5.0))
+    assert last < first
+
+
+def test_pgpe_improves():
+    s = PGPE(
+        make_problem(),
+        popsize=50,
+        center_learning_rate=0.4,
+        stdev_learning_rate=0.1,
+        stdev_init=2.0,
+    )
+    first, last = improvement(s)
+    assert last < first
+
+
+def test_cem_improves():
+    s = CEM(make_problem(), popsize=50, parenthood_ratio=0.5, stdev_init=2.0)
+    first, last = improvement(s)
+    assert last < first
+
+
+def test_xnes_improves():
+    s = XNES(make_problem(n=6), stdev_init=2.0)
+    first, last = improvement(s, gens=50)
+    assert last < first
+
+
+def test_cmaes_improves():
+    s = CMAES(make_problem(n=6), stdev_init=2.0)
+    first, last = improvement(s, gens=60)
+    assert last < first
+    assert s.status["iter"] == 61
+
+
+def test_cmaes_separable():
+    s = CMAES(make_problem(n=8), stdev_init=2.0, separable=True, popsize=20)
+    first, last = improvement(s, gens=60)
+    assert last < first
+
+
+def test_pgpe_distributed_mode():
+    # distributed=True goes through problem.sample_and_compute_gradients
+    s = PGPE(
+        make_problem(),
+        popsize=64,
+        center_learning_rate=0.4,
+        stdev_learning_rate=0.1,
+        stdev_init=2.0,
+        distributed=True,
+    )
+    s.run(20)
+    assert s.status["mean_eval"] is not None
+    center = s.status["center"]
+    assert center.shape == (10,)
+
+
+def test_ga_improves():
+    p = make_problem()
+    ga = GeneticAlgorithm(
+        p,
+        operators=[
+            OnePointCrossOver(p, tournament_size=4),
+            GaussianMutation(p, stdev=0.5),
+        ],
+        popsize=40,
+    )
+    first, last = improvement(ga, gens=25)
+    assert last < first
+
+
+def test_ga_multiobjective_nsga2_like():
+    @vectorized
+    def two_obj(xs):
+        # classic convex front: f1 = x0^2 stuff, f2 = (x-1)^2 stuff
+        return jnp.stack(
+            [jnp.sum(xs**2, axis=-1), jnp.sum((xs - 2.0) ** 2, axis=-1)], axis=1
+        )
+
+    p = Problem(["min", "min"], two_obj, solution_length=5, initial_bounds=(-5, 5))
+    ga = GeneticAlgorithm(
+        p,
+        operators=[
+            SimulatedBinaryCrossOver(p, tournament_size=3, eta=8.0),
+            GaussianMutation(p, stdev=0.3),
+        ],
+        popsize=32,
+    )
+    ga.run(15)
+    pop = ga.population
+    ranks = np.asarray(pop.compute_pareto_ranks())
+    # after selection pressure most of the population should be near front 0
+    assert (ranks == 0).sum() >= len(pop) // 4
+
+
+def test_steady_state_ga_use():
+    p = make_problem()
+    ga = SteadyStateGA(p, popsize=30)
+    with pytest.raises(RuntimeError):
+        ga.step()
+    ga.use(OnePointCrossOver(p, tournament_size=3))
+    ga.use(GaussianMutation(p, stdev=0.3))
+    first, last = improvement(ga, gens=20)
+    assert last < first
+
+
+def test_cosyne_improves():
+    s = Cosyne(
+        make_problem(),
+        popsize=40,
+        tournament_size=4,
+        mutation_stdev=0.3,
+        num_elites=2,
+    )
+    first, last = improvement(s, gens=25)
+    assert last < first
+
+
+def test_status_and_hooks_machinery():
+    s = SNES(make_problem(), stdev_init=5.0)
+    events = []
+    s.before_step_hook.append(lambda: events.append("before"))
+    s.after_step_hook.append(lambda: {"extra_metric": 1.23})
+    logged = []
+    s.log_hook.append(lambda status: logged.append(status))
+    ended = []
+    s.end_of_run_hook.append(lambda status: ended.append(status))
+    s.run(3)
+    assert events == ["before"] * 3
+    assert len(logged) == 3
+    assert logged[-1]["iter"] == 3
+    assert logged[-1]["extra_metric"] == 1.23
+    assert len(ended) == 1
+    assert "pop_best_eval" in logged[-1]
+    assert "median_eval" in dict(s.status.items())
+
+
+def test_searcher_population_property():
+    s = CEM(make_problem(), popsize=20, parenthood_ratio=0.5, stdev_init=1.0)
+    with pytest.raises(RuntimeError):
+        _ = s.population
+    s.step()
+    assert len(s.population) == 20

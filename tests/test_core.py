@@ -1,0 +1,332 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu import rowwise, vectorized
+from evotorch_tpu.core import Problem, Solution, SolutionBatch
+from evotorch_tpu.tools import ObjectArray
+
+
+@vectorized
+def sphere(xs):
+    return jnp.sum(xs**2, axis=-1)
+
+
+def make_problem(**kwargs):
+    defaults = dict(
+        objective_sense="min",
+        objective_func=sphere,
+        solution_length=4,
+        initial_bounds=(-1.0, 1.0),
+        seed=3,
+    )
+    defaults.update(kwargs)
+    return Problem(defaults.pop("objective_sense"), defaults.pop("objective_func"), **defaults)
+
+
+# ----------------------------------------------------------------- Problem --
+
+
+def test_problem_basics():
+    p = make_problem()
+    assert p.senses == ["min"]
+    assert not p.is_multi_objective
+    assert p.solution_length == 4
+    assert p.dtype == jnp.float32
+    with pytest.raises(ValueError):
+        Problem("minimize", sphere, solution_length=2)
+
+
+def test_generate_batch_within_initial_bounds():
+    p = make_problem()
+    batch = p.generate_batch(10)
+    vals = np.asarray(batch.values)
+    assert vals.shape == (10, 4)
+    assert vals.min() >= -1.0 and vals.max() <= 1.0
+    assert not batch.is_evaluated
+
+
+def test_evaluate_vectorized():
+    p = make_problem()
+    batch = p.generate_batch(8)
+    p.evaluate(batch)
+    assert batch.is_evaluated
+    expected = np.sum(np.asarray(batch.values) ** 2, axis=-1)
+    assert np.allclose(np.asarray(batch.evals[:, 0]), expected, atol=1e-6)
+
+
+def test_evaluate_per_solution_loop():
+    # non-vectorized objective: gets one row at a time
+    def row_fitness(x):
+        assert x.ndim == 1
+        return jnp.sum(jnp.abs(x))
+
+    p = Problem("min", row_fitness, solution_length=3, initial_bounds=(-1, 1))
+    batch = p.generate_batch(5)
+    p.evaluate(batch)
+    assert batch.is_evaluated
+
+
+def test_best_worst_tracking_and_status():
+    p = make_problem()
+    batch = p.generate_batch(20)
+    p.evaluate(batch)
+    status = p.status
+    assert "best" in status and "best_eval" in status
+    assert status["best_eval"] <= status["worst_eval"]
+    # best only improves over generations
+    first_best = status["best_eval"]
+    batch2 = p.generate_batch(20)
+    p.evaluate(batch2)
+    assert p.status["best_eval"] <= first_best
+
+
+def test_eval_hooks():
+    p = make_problem()
+    seen = []
+    p.before_eval_hook.append(lambda b: seen.append(len(b)))
+    p.after_eval_hook.append(lambda b: {"custom_metric": 42})
+    p.evaluate(p.generate_batch(6))
+    assert seen == [6]
+    assert p.status["custom_metric"] == 42
+
+
+def test_manual_seed_determinism():
+    p1 = make_problem(seed=7)
+    p2 = make_problem(seed=7)
+    assert np.allclose(np.asarray(p1.generate_values(5)), np.asarray(p2.generate_values(5)))
+
+
+def test_multiobjective_problem():
+    @vectorized
+    def two_obj(xs):
+        return jnp.stack([jnp.sum(xs**2, axis=-1), jnp.sum(jnp.abs(xs), axis=-1)], axis=1)
+
+    p = Problem(["min", "min"], two_obj, solution_length=3, initial_bounds=(-1, 1))
+    assert p.is_multi_objective
+    batch = p.generate_batch(12)
+    p.evaluate(batch)
+    assert batch.evals.shape == (12, 2)
+    ranks = batch.compute_pareto_ranks()
+    assert ranks.shape == (12,)
+    fronts = batch.arg_pareto_sort()
+    assert sum(len(f) for f in fronts) == 12
+    best2 = batch.take_best(5)
+    assert len(best2) == 5
+
+
+def test_eval_data_length():
+    @vectorized
+    def with_extra(xs):
+        fit = jnp.sum(xs**2, axis=-1)
+        extra = jnp.ones((xs.shape[0], 2))
+        return fit[:, None], extra
+
+    p = Problem("min", with_extra, solution_length=3, initial_bounds=(-1, 1), eval_data_length=2)
+    batch = p.generate_batch(4)
+    p.evaluate(batch)
+    assert batch.evals.shape == (4, 3)
+    assert np.allclose(np.asarray(batch.evdata), 1.0)
+
+
+def test_bounds_validation():
+    with pytest.raises(ValueError):
+        Problem("min", sphere, solution_length=2, bounds=(1.0, -1.0))
+    p = Problem("min", sphere, solution_length=2, bounds=(-2.0, 2.0))
+    assert np.allclose(np.asarray(p.lower_bounds), -2.0)
+    assert np.allclose(np.asarray(p.upper_bounds), 2.0)
+
+
+def test_object_dtype_problem():
+    class ListProblem(Problem):
+        def __init__(self):
+            super().__init__("max", dtype=object)
+
+        def _fill(self, n, key):
+            arr = ObjectArray(n)
+            for i in range(n):
+                arr[i] = [i, i + 1]
+            return arr
+
+        def _evaluate(self, solution):
+            solution.set_evals(float(sum(solution.values)))
+
+    p = ListProblem()
+    batch = p.generate_batch(3)
+    p.evaluate(batch)
+    assert np.asarray(batch.evals[:, 0]).tolist() == [1.0, 3.0, 5.0]
+
+
+# ----------------------------------------------------------- SolutionBatch --
+
+
+def test_batch_nan_semantics_and_set_evals():
+    p = make_problem()
+    batch = p.generate_batch(5)
+    assert not batch.is_evaluated
+    batch.set_evals(jnp.arange(5.0))
+    assert batch.is_evaluated
+    assert np.allclose(np.asarray(batch.evals[:, 0]), np.arange(5.0))
+
+
+def test_access_values_clears_evals():
+    p = make_problem()
+    batch = p.generate_batch(5)
+    batch.set_evals(jnp.arange(5.0))
+    _ = batch.access_values()
+    assert not batch.is_evaluated
+    batch.set_evals(jnp.arange(5.0))
+    _ = batch.access_values(keep_evals=True)
+    assert batch.is_evaluated
+
+
+def test_argsort_argbest_take():
+    p = make_problem()
+    batch = p.generate_batch(6)
+    batch.set_evals(jnp.array([3.0, 1.0, 2.0, 6.0, 5.0, 4.0]))
+    order = np.asarray(batch.argsort())
+    assert order[0] == 1  # min problem: best is lowest
+    assert int(batch.argbest()) == 1
+    assert int(batch.argworst()) == 3
+    best3 = batch.take_best(3)
+    assert np.asarray(best3.evals[:, 0]).tolist() == [1.0, 2.0, 3.0]
+
+
+def test_slice_scatter_back():
+    # evaluating a piece must write results into the parent batch
+    p = make_problem()
+    batch = p.generate_batch(10)
+    pieces = batch.split(2)
+    assert len(pieces) == 2
+    p.evaluate(pieces[0])
+    p.evaluate(pieces[1])
+    assert batch.is_evaluated
+    lo, hi = pieces.indices_of(1)
+    assert (lo, hi) == (5, 10)
+
+
+def test_getitem_solution_and_subbatch():
+    p = make_problem()
+    batch = p.generate_batch(6)
+    sln = batch[2]
+    assert isinstance(sln, Solution)
+    sub = batch[1:4]
+    assert isinstance(sub, SolutionBatch) and len(sub) == 3
+    sln.set_evals(7.5)
+    assert float(batch.evals[2, 0]) == 7.5
+
+
+def test_solution_set_values_invalidates_evals():
+    p = make_problem()
+    batch = p.generate_batch(3)
+    batch.set_evals(jnp.ones(3))
+    batch[0].set_values(jnp.zeros(4))
+    assert np.isnan(float(batch.evals[0, 0]))
+    assert float(batch.evals[1, 0]) == 1.0
+    assert np.allclose(np.asarray(batch[0].values), 0.0)
+
+
+def test_merge_and_cat():
+    p = make_problem()
+    b1 = p.generate_batch(3)
+    b2 = p.generate_batch(2)
+    merged = b1.concat(b2)
+    assert len(merged) == 5
+    assert len(SolutionBatch.cat([b1, b2, b1])) == 8
+
+
+def test_utility_and_utils():
+    p = make_problem()
+    batch = p.generate_batch(4)
+    batch.set_evals(jnp.array([4.0, 1.0, 3.0, 2.0]))
+    u = np.asarray(batch.utility(ranking_method="centered"))
+    assert u[1] == 0.5  # best (lowest, min problem)
+    assert batch.utils(ranking_method="centered").shape == (4, 1)
+
+
+def test_clone_independent():
+    p = make_problem()
+    batch = p.generate_batch(3)
+    batch.set_evals(jnp.ones(3))
+    c = batch.clone()
+    c.set_evals(jnp.zeros(3))
+    assert float(batch.evals[0, 0]) == 1.0
+
+
+# --------------------------------------------------- ProblemBoundEvaluator --
+
+
+def test_problem_bound_evaluator():
+    p = make_problem()
+    f = p.make_callable_evaluator()
+    values = jnp.ones((5, 4))
+    fits = f(values)
+    assert np.allclose(np.asarray(fits), 4.0)
+    # extra batch dims by reshape
+    fits_b = f(jnp.ones((2, 5, 4)))
+    assert fits_b.shape == (2, 5)
+
+
+def test_problem_pickling():
+    import pickle
+
+    p = make_problem()
+    p.evaluate(p.generate_batch(4))
+    # objective_func is module-level, so the problem pickles
+    restored = pickle.loads(pickle.dumps(p))
+    assert restored.senses == ["min"]
+    batch = restored.generate_batch(3)
+    restored.evaluate(batch)
+    assert batch.is_evaluated
+
+
+def test_object_piece_value_writes_propagate():
+    # review regression: object-dtype pieces must propagate value writes
+    class ListProblem2(Problem):
+        def __init__(self):
+            super().__init__("max", dtype=object)
+
+        def _fill(self, n, key):
+            arr = ObjectArray(n)
+            for i in range(n):
+                arr[i] = [i]
+            return arr
+
+        def _evaluate(self, solution):
+            solution.set_evals(float(sum(solution.values)))
+
+    p = ListProblem2()
+    batch = p.generate_batch(4)
+    piece = batch[0:2]
+    piece[0].set_values([99, 1])
+    assert list(batch[0].values) == [99, 1]
+    taken = batch.take([1, 3])
+    taken[0].set_values([7])
+    assert list(batch[1].values) == [7]
+
+
+def test_sample_and_compute_gradients_adaptive():
+    from evotorch_tpu.distributions import SeparableGaussian
+
+    interactions = {"n": 0}
+
+    class CountingProblem(Problem):
+        def __init__(self):
+            super().__init__("min", solution_length=3, initial_bounds=(-1, 1))
+            self.after_eval_hook.append(self._report)
+
+        def _evaluate_batch(self, batch):
+            interactions["n"] += len(batch) * 10
+            batch.set_evals(jnp.sum(jnp.asarray(batch.values) ** 2, axis=-1))
+
+        def _report(self, batch):
+            return {"total_interaction_count": interactions["n"]}
+
+    p = CountingProblem()
+    dist = SeparableGaussian({"mu": jnp.zeros(3), "sigma": jnp.ones(3)})
+    [result] = p.sample_and_compute_gradients(
+        dist, 10, num_interactions=250, popsize_max=100, ranking_method="centered"
+    )
+    # 10 solutions -> 100 interactions per chunk; threshold 250 -> 3 chunks
+    assert result["num_solutions"] == 30

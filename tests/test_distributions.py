@@ -1,0 +1,154 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.distributions import (
+    ExpGaussian,
+    ExpSeparableGaussian,
+    SeparableGaussian,
+    SymmetricSeparableGaussian,
+    make_functional_grad_estimator,
+    make_functional_sampler,
+)
+
+
+def test_separable_sample_stats():
+    d = SeparableGaussian({"mu": jnp.array([1.0, -2.0]), "sigma": jnp.array([0.5, 2.0])})
+    s = d.sample(20000, key=jax.random.key(0))
+    assert s.shape == (20000, 2)
+    assert np.allclose(np.asarray(jnp.mean(s, axis=0)), [1.0, -2.0], atol=0.05)
+    assert np.allclose(np.asarray(jnp.std(s, axis=0)), [0.5, 2.0], atol=0.05)
+
+
+def test_separable_gradients_direction():
+    # fitness = x[0]: gradient of mu[0] should be positive when maximizing
+    mu = jnp.zeros(3)
+    sigma = jnp.ones(3)
+    d = SeparableGaussian({"mu": mu, "sigma": sigma})
+    samples = d.sample(4000, key=jax.random.key(1))
+    fit = samples[:, 0]
+    grads = d.compute_gradients(samples, fit, objective_sense="max", ranking_method="centered")
+    assert float(grads["mu"][0]) > 10.0 * abs(float(grads["mu"][1]))
+    # minimizing flips the sign
+    grads_min = d.compute_gradients(samples, fit, objective_sense="min", ranking_method="centered")
+    assert float(grads_min["mu"][0]) < 0
+
+
+def test_separable_update_with_learning_rates():
+    d = SeparableGaussian({"mu": jnp.zeros(2), "sigma": jnp.ones(2)})
+    new = d.update_parameters(
+        {"mu": jnp.array([1.0, 0.0]), "sigma": jnp.array([0.0, -0.5])},
+        learning_rates={"mu": 0.1, "sigma": 0.2},
+    )
+    assert np.allclose(np.asarray(new.mu), [0.1, 0.0])
+    assert np.allclose(np.asarray(new.sigma), [1.0, 0.9])
+
+
+def test_symmetric_sampling_antithetic():
+    d = SymmetricSeparableGaussian({"mu": jnp.array([5.0, 5.0]), "sigma": jnp.ones(2)})
+    s = d.sample(10, key=jax.random.key(0))
+    # interleaved pairs: s[0] + s[1] == 2*mu
+    assert np.allclose(np.asarray(s[0::2] + s[1::2]), 10.0, atol=1e-5)
+    with pytest.raises(ValueError):
+        d.sample(5, key=jax.random.key(0))
+
+
+def test_symmetric_gradients_solve_simple_quadratic():
+    # maximize -|x - 3|^2 via symmetric PGPE-style updates
+    d = SymmetricSeparableGaussian({"mu": jnp.zeros(4), "sigma": jnp.full((4,), 1.0)})
+    key = jax.random.key(42)
+    for _ in range(40):
+        key, sub = jax.random.split(key)
+        samples = d.sample(100, key=sub)
+        fit = -jnp.sum((samples - 3.0) ** 2, axis=-1)
+        grads = d.compute_gradients(samples, fit, objective_sense="max", ranking_method="centered")
+        d = d.update_parameters(grads, learning_rates={"mu": 0.3, "sigma": 0.05})
+    assert np.allclose(np.asarray(d.mu), 3.0, atol=0.5)
+
+
+def test_exp_separable_snes_update():
+    d = ExpSeparableGaussian({"mu": jnp.zeros(2), "sigma": jnp.ones(2)})
+    new = d.update_parameters(
+        {"mu": jnp.array([0.5, 0.0]), "sigma": jnp.array([1.0, -1.0])},
+        learning_rates={"mu": 1.0, "sigma": 0.2},
+    )
+    assert np.allclose(np.asarray(new.mu), [0.5, 0.0])
+    # sigma multiplied by exp(0.5 * lr * grad)
+    assert np.allclose(np.asarray(new.sigma), [np.exp(0.1), np.exp(-0.1)], atol=1e-6)
+
+
+def test_expgaussian_roundtrip_and_update():
+    A = jnp.array([[2.0, 0.0], [0.5, 1.0]])
+    d = ExpGaussian({"mu": jnp.array([1.0, 2.0]), "sigma": A})
+    z = jax.random.normal(jax.random.key(0), (7, 2))
+    x = d.to_global_coordinates(z)
+    z2 = d.to_local_coordinates(x)
+    assert np.allclose(np.asarray(z), np.asarray(z2), atol=1e-4)
+
+    samples = d.sample(3000, key=jax.random.key(1))
+    fit = samples[:, 0]
+    grads = d.compute_gradients(samples, fit, objective_sense="max", ranking_method="centered")
+    assert set(grads) == {"d", "M"}
+    new = d.update_parameters(grads, learning_rates={"mu": 0.1, "sigma": 0.01})
+    # A_inv stays the inverse of A after the expm update (float32 tolerance)
+    assert np.allclose(np.asarray(new.A @ new.A_inv), np.eye(2), atol=2e-2)
+    assert float(new.mu[0]) > float(d.mu[0])
+
+
+def test_functional_sampler_batched():
+    sampler = make_functional_sampler(SeparableGaussian)
+    mu = jnp.stack([jnp.zeros(3), jnp.full((3,), 10.0)])  # batch of 2 searches
+    sigma = jnp.ones(3)
+    out = sampler(jax.random.key(0), 50, {"mu": mu, "sigma": sigma})
+    assert out.shape == (2, 50, 3)
+    assert abs(float(jnp.mean(out[0]))) < 0.5
+    assert abs(float(jnp.mean(out[1])) - 10.0) < 0.5
+    # batches get different noise
+    assert not np.allclose(np.asarray(out[0]), np.asarray(out[1]) - 10.0)
+
+
+def test_functional_grad_estimator_batched():
+    est = make_functional_grad_estimator(
+        SeparableGaussian, objective_sense="max", ranking_method="centered"
+    )
+    key = jax.random.key(0)
+    mu = jnp.zeros((2, 3))
+    sigma = jnp.ones(3)
+    sampler = make_functional_sampler(SeparableGaussian)
+    samples = sampler(key, 200, {"mu": mu, "sigma": sigma})
+    fits = samples[..., 0]
+    grads = est(samples, fits, {"mu": mu, "sigma": sigma})
+    assert grads["mu"].shape == (2, 3)
+    assert float(grads["mu"][0, 0]) > 0 and float(grads["mu"][1, 0]) > 0
+
+
+def test_bound_function_grad_estimator():
+    est = make_functional_grad_estimator(
+        SymmetricSeparableGaussian,
+        function=lambda xs: -jnp.sum(xs**2, axis=-1),
+        objective_sense="max",
+        ranking_method="centered",
+        return_samples=True,
+        return_fitnesses=True,
+    )
+    grads, samples, fits = est(
+        jax.random.key(3), 100, {"mu": jnp.full((4,), 5.0), "sigma": jnp.ones(4)}
+    )
+    assert samples.shape == (100, 4)
+    assert fits.shape == (100,)
+    # maximizing -x^2 from mu=5: gradient pulls mu down
+    assert all(float(g) < 0 for g in grads["mu"])
+
+
+def test_unknown_parameter_rejected():
+    with pytest.raises(ValueError):
+        SeparableGaussian({"mu": jnp.zeros(2), "sigma": jnp.ones(2), "bogus": 1})
+
+
+def test_kl_divergence():
+    a = SeparableGaussian({"mu": jnp.zeros(2), "sigma": jnp.ones(2)})
+    b = SeparableGaussian({"mu": jnp.zeros(2), "sigma": jnp.ones(2)})
+    assert a.relative_entropy(b) == pytest.approx(0.0, abs=1e-6)
+    c = SeparableGaussian({"mu": jnp.ones(2), "sigma": jnp.ones(2)})
+    assert a.relative_entropy(c) > 0

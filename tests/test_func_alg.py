@@ -1,0 +1,143 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.algorithms.functional import cem, cem_ask, cem_tell, pgpe, pgpe_ask, pgpe_tell
+
+from helpers import run_functional_search
+
+
+def sphere(x):
+    return jnp.sum(x**2, axis=-1)
+
+
+def test_cem_minimizes_sphere():
+    state = cem(
+        center_init=jnp.full((5,), 3.0),
+        parenthood_ratio=0.5,
+        objective_sense="min",
+        stdev_init=2.0,
+        stdev_max_change=0.2,
+    )
+    state, _ = run_functional_search(
+        state, jax.random.key(0),
+        ask=cem_ask, tell=cem_tell, fitness=sphere, popsize=50, num_generations=100,
+    )
+    assert float(sphere(state.center[None])[0]) < 0.1
+
+
+def test_cem_maximization():
+    fitness = lambda pop: -sphere(pop - 2.0)  # noqa: E731
+    state = cem(
+        center_init=jnp.zeros(3),
+        parenthood_ratio=0.5,
+        objective_sense="max",
+        stdev_init=1.0,
+        stdev_max_change=0.3,  # guard against premature stdev collapse
+    )
+    state, _ = run_functional_search(
+        state, jax.random.key(1),
+        ask=cem_ask, tell=cem_tell, fitness=fitness, popsize=40, num_generations=80,
+    )
+    assert np.allclose(np.asarray(state.center), 2.0, atol=0.3)
+
+
+def test_pgpe_minimizes_sphere_with_clipup():
+    # ClipUp takes fixed-norm steps, so the steady-state error is O(stepsize)
+    state = pgpe(
+        center_init=jnp.full((6,), 5.0),
+        center_learning_rate=0.15,
+        stdev_learning_rate=0.1,
+        objective_sense="min",
+        ranking_method="centered",
+        optimizer="clipup",
+        stdev_init=2.0,
+    )
+    state, means = run_functional_search(
+        state, jax.random.key(2),
+        ask=pgpe_ask, tell=pgpe_tell, fitness=sphere, popsize=40, num_generations=300,
+    )
+    assert float(sphere(state.optimizer_state.center[None])[0]) < 0.5
+    assert float(means[-1]) < float(means[0])
+
+
+def test_pgpe_nonsymmetric_adam():
+    fitness = lambda pop: -sphere(pop - 1.0)  # noqa: E731
+    state = pgpe(
+        center_init=jnp.zeros(4),
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.05,
+        objective_sense="max",
+        optimizer="adam",
+        stdev_init=1.0,
+        symmetric=False,
+    )
+    state, _ = run_functional_search(
+        state, jax.random.key(3),
+        ask=pgpe_ask, tell=pgpe_tell, fitness=fitness, popsize=50, num_generations=150,
+    )
+    assert np.allclose(np.asarray(state.optimizer_state.center), 1.0, atol=0.4)
+
+
+def test_pgpe_rejects_odd_popsize_when_symmetric():
+    state = pgpe(
+        center_init=jnp.zeros(2),
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        objective_sense="min",
+        stdev_init=1.0,
+    )
+    with pytest.raises(ValueError):
+        pgpe_ask(jax.random.key(0), state, popsize=7)
+
+
+def test_batched_cem_search():
+    # two batched searches tracking *different* targets must progress
+    # independently (this fails if the batch lanes share sampling noise)
+    targets = jnp.array([[0.0, 0.0, 0.0], [3.0, 3.0, 3.0]])
+    fitness = lambda pop: sphere(pop - targets[:, None, :])  # noqa: E731
+    state = cem(
+        center_init=jnp.zeros((2, 3)),
+        parenthood_ratio=0.5,
+        objective_sense="min",
+        stdev_init=2.0,
+        stdev_max_change=0.3,
+    )
+    state, _ = run_functional_search(
+        state, jax.random.key(4),
+        ask=cem_ask, tell=cem_tell, fitness=fitness, popsize=30, num_generations=80,
+    )
+    assert np.allclose(np.asarray(state.center), np.asarray(targets), atol=0.5)
+
+
+def test_cem_ask_population_shape_batched():
+    state = cem(
+        center_init=jnp.zeros((2, 3)),
+        parenthood_ratio=0.5,
+        objective_sense="min",
+        stdev_init=1.0,
+    )
+    pop = cem_ask(jax.random.key(0), state, popsize=30)
+    assert pop.shape == (2, 30, 3)
+
+
+def test_func_alg_under_jit_scan():
+    # a PGPE run driven through the shared scan helper, then resumed:
+    # states must round-trip through scan carries
+    state = pgpe(
+        center_init=jnp.full((5,), 3.0),
+        center_learning_rate=0.3,
+        stdev_learning_rate=0.1,
+        objective_sense="min",
+        stdev_init=1.0,
+    )
+    state, means1 = run_functional_search(
+        state, jax.random.key(5),
+        ask=pgpe_ask, tell=pgpe_tell, fitness=sphere, popsize=40, num_generations=75,
+    )
+    state, means2 = run_functional_search(
+        state, jax.random.key(6),
+        ask=pgpe_ask, tell=pgpe_tell, fitness=sphere, popsize=40, num_generations=75,
+    )
+    assert float(means2[-1]) < float(means1[0])

@@ -1,0 +1,263 @@
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.operators import functional as F
+from evotorch_tpu.tools import ObjectArray
+
+
+# ---------------------------------------------------------------- pareto ----
+
+
+def brute_force_dominates(e1, e2, senses):
+    adj = lambda v, s: v if s == "max" else -v  # noqa: E731
+    a1 = [adj(x, s) for x, s in zip(e1, senses)]
+    a2 = [adj(x, s) for x, s in zip(e2, senses)]
+    return all(x >= y for x, y in zip(a1, a2)) and any(x > y for x, y in zip(a1, a2))
+
+
+def brute_force_ranks(evals, senses):
+    n = len(evals)
+    remaining = set(range(n))
+    ranks = [None] * n
+    k = 0
+    while remaining:
+        front = [
+            i
+            for i in remaining
+            if not any(brute_force_dominates(evals[j], evals[i], senses) for j in remaining if j != i)
+        ]
+        for i in front:
+            ranks[i] = k
+        remaining -= set(front)
+        k += 1
+    return ranks
+
+
+def test_pareto_ranks_against_brute_force():
+    key = jax.random.key(0)
+    evals = jax.random.normal(key, (40, 3))
+    senses = ["max", "min", "max"]
+    got = np.asarray(F.pareto_ranks(evals, objective_sense=senses))
+    expected = brute_force_ranks(np.asarray(evals).tolist(), senses)
+    assert got.tolist() == expected
+
+
+def test_dominates_and_matrix():
+    senses = ["max", "max"]
+    assert bool(F.dominates(jnp.array([2.0, 2.0]), jnp.array([1.0, 1.0]), objective_sense=senses))
+    assert not bool(F.dominates(jnp.array([2.0, 0.0]), jnp.array([1.0, 1.0]), objective_sense=senses))
+    evals = jnp.array([[2.0, 2.0], [1.0, 1.0], [3.0, 0.0]])
+    m = np.asarray(F.domination_matrix(evals, objective_sense=senses))
+    assert m[0, 1] and not m[1, 0] and not m[0, 2] and not m[2, 0]
+    counts = np.asarray(F.domination_counts(evals, objective_sense=senses))
+    assert counts.tolist() == [0, 1, 0]
+
+
+def test_crowding_boundaries_infinite():
+    evals = jnp.array([[0.0, 3.0], [1.0, 2.0], [2.0, 1.0], [3.0, 0.0]])
+    senses = ["max", "max"]
+    d = np.asarray(F.crowding_distances(evals, objective_sense=senses))
+    # all on one front; extremes get inf
+    assert np.isinf(d[0]) and np.isinf(d[3])
+    assert np.isfinite(d[1]) and np.isfinite(d[2])
+
+
+def test_pareto_utility_ordering():
+    # solution 0 dominates all; 1 and 2 are a front; 3 is dominated by all
+    evals = jnp.array([[5.0, 5.0], [3.0, 4.0], [4.0, 3.0], [1.0, 1.0]])
+    u = np.asarray(F.pareto_utility(evals, objective_sense=["max", "max"]))
+    assert u[0] > u[1] and u[0] > u[2] and min(u[1], u[2]) > u[3]
+
+
+# ------------------------------------------------------------ tournament ----
+
+
+def test_tournament_indices_and_quality():
+    key = jax.random.key(1)
+    values = jnp.arange(20.0)[:, None] * jnp.ones((1, 3))
+    evals = jnp.arange(20.0)  # higher index = higher fitness
+    idx = F.tournament(
+        key, values, evals,
+        num_tournaments=100, tournament_size=4,
+        objective_sense="max", return_indices=True,
+    )
+    assert idx.shape == (100,)
+    # tournament selection should favor good solutions strongly
+    assert float(jnp.mean(evals[idx])) > float(jnp.mean(evals))
+    p1, p2 = F.tournament(
+        key, values, evals,
+        num_tournaments=10, tournament_size=4,
+        objective_sense="max", split_results=True,
+    )
+    assert p1.shape == (5, 3) and p2.shape == (5, 3)
+
+
+def test_tournament_objectarray():
+    key = jax.random.key(2)
+    solutions = ObjectArray.from_values([f"s{i}" for i in range(10)])
+    evals = jnp.arange(10.0)
+    picked = F.tournament(
+        key, solutions, evals,
+        num_tournaments=6, tournament_size=3, objective_sense="max",
+    )
+    assert len(picked) == 6
+    assert all(isinstance(p, str) for p in picked)
+
+
+# ------------------------------------------------------------- crossover ----
+
+
+def test_multi_point_crossover_children_are_recombinations():
+    key = jax.random.key(3)
+    p1 = jnp.zeros((4, 10))
+    p2 = jnp.ones((4, 10))
+    parents = jnp.concatenate([p1, p2])
+    children = F.multi_point_cross_over(key, parents, num_points=2)
+    assert children.shape == (8, 10)
+    vals = np.asarray(children)
+    assert set(np.unique(vals)).issubset({0.0, 1.0})
+    # complementary children: first half + second half == all ones
+    assert np.allclose(vals[:4] + vals[4:], 1.0)
+    # at least one child mixes genes from both parents
+    mixed = [(0.0 in row) and (1.0 in row) for row in vals]
+    assert any(mixed)
+
+
+def test_one_point_crossover_structure():
+    key = jax.random.key(4)
+    parents = jnp.concatenate([jnp.zeros((3, 8)), jnp.ones((3, 8))])
+    children = np.asarray(F.one_point_cross_over(key, parents))
+    for row in children:
+        # a single cut: at most one 0->1 or 1->0 transition
+        transitions = np.sum(row[1:] != row[:-1])
+        assert transitions <= 1
+
+
+def test_crossover_with_tournament():
+    key = jax.random.key(5)
+    pop = jax.random.normal(key, (20, 5))
+    evals = jnp.sum(pop, axis=-1)
+    children = F.multi_point_cross_over(
+        key, pop, evals, num_points=1, tournament_size=3,
+        num_children=10, objective_sense="max",
+    )
+    assert children.shape == (10, 5)
+
+
+def test_sbx_preserves_mean():
+    key = jax.random.key(6)
+    parents = jax.random.normal(key, (40, 6))
+    children = F.simulated_binary_cross_over(key, parents, eta=15.0)
+    assert children.shape == (40, 6)
+    # SBX children are symmetric around parent means
+    p1, p2 = parents[:20], parents[20:]
+    c1, c2 = children[:20], children[20:]
+    assert np.allclose(np.asarray(c1 + c2), np.asarray(p1 + p2), atol=1e-4)
+
+
+# -------------------------------------------------------------- mutation ----
+
+
+def test_gaussian_mutation():
+    key = jax.random.key(7)
+    values = jnp.zeros((10, 4))
+    out = F.gaussian_mutation(key, values, stdev=1.0)
+    assert out.shape == values.shape
+    assert float(jnp.std(out)) > 0.5
+    gated = F.gaussian_mutation(key, values, stdev=1.0, mutation_probability=0.0)
+    assert np.allclose(np.asarray(gated), 0.0)
+
+
+def test_polynomial_mutation_bounds():
+    key = jax.random.key(8)
+    values = jax.random.uniform(key, (30, 5), minval=-1.0, maxval=1.0)
+    out = F.polynomial_mutation(key, values, lb=-1.0, ub=1.0, eta=20.0)
+    assert float(jnp.min(out)) >= -1.0 and float(jnp.max(out)) <= 1.0
+    assert not np.allclose(np.asarray(out), np.asarray(values))
+
+
+# ------------------------------------------------------------ permutation ----
+
+
+def test_cosyne_permutation_full():
+    key = jax.random.key(9)
+    values = jnp.arange(30.0).reshape(10, 3)
+    out = F.cosyne_permutation(key, values, permute_all=True)
+    # each column is a permutation of the original column
+    for j in range(3):
+        assert sorted(np.asarray(out[:, j]).tolist()) == sorted(np.asarray(values[:, j]).tolist())
+    assert not np.allclose(np.asarray(out), np.asarray(values))
+
+
+def test_cosyne_permutation_partial_respects_fitness():
+    key = jax.random.key(10)
+    values = jnp.arange(200.0).reshape(100, 2)
+    evals = jnp.arange(100.0)
+    out = F.cosyne_permutation(key, values, evals, permute_all=False, objective_sense="max")
+    # best solutions mostly keep their values; worst mostly change
+    changed = np.asarray(jnp.any(out != values, axis=-1))
+    assert changed[:50].sum() > changed[50:].sum()
+
+
+# ------------------------------------------------------- combine/take_best --
+
+
+def test_combine_and_take_best_single_objective():
+    v1, e1 = jnp.zeros((3, 2)), jnp.array([1.0, 2.0, 3.0])
+    v2, e2 = jnp.ones((2, 2)), jnp.array([5.0, 0.0])
+    values, evals = F.combine((v1, e1), (v2, e2))
+    assert values.shape == (5, 2) and evals.shape == (5,)
+    best_v, best_e = F.take_best(values, evals, objective_sense="max")
+    assert float(best_e) == 5.0
+    top_v, top_e = F.take_best(values, evals, 2, objective_sense="max")
+    assert np.asarray(top_e).tolist() == [5.0, 3.0]
+    low_v, low_e = F.take_best(values, evals, 2, objective_sense="min")
+    assert np.asarray(low_e).tolist() == [0.0, 1.0]
+
+
+def test_take_best_multiobjective_prefers_first_front():
+    evals = jnp.array([[5.0, 5.0], [3.0, 4.0], [4.0, 3.0], [1.0, 1.0]])
+    values = jnp.arange(4.0)[:, None] * jnp.ones((1, 2))
+    top_v, top_e = F.take_best(values, evals, 3, objective_sense=["max", "max"])
+    picked = set(np.asarray(top_v[:, 0]).astype(int).tolist())
+    assert 0 in picked and 3 not in picked
+
+
+def test_take_best_objectarray():
+    values = ObjectArray.from_values(["a", "b", "c"])
+    evals = jnp.array([3.0, 1.0, 2.0])
+    v, e = F.take_best(values, evals, objective_sense="min")
+    assert v == "b" and float(e) == 1.0
+
+
+def test_combine_objectarray():
+    a = ObjectArray.from_values([1, 2])
+    b = ObjectArray.from_values([3])
+    merged = F.combine(a, b)
+    assert list(merged) == [1, 2, 3]
+
+
+# ------------------------------------------------------------- jit-ability --
+
+
+def test_pareto_selection_under_jit():
+    @jax.jit
+    def select(values, evals):
+        return F.take_best(values, evals, 4, objective_sense=["max", "max"])
+
+    key = jax.random.key(11)
+    values = jax.random.normal(key, (16, 3))
+    evals = jax.random.normal(key, (16, 2))
+    v, e = select(values, evals)
+    assert v.shape == (4, 3) and e.shape == (4, 2)
+
+
+def test_batched_utility():
+    evals = jnp.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
+    u = F.utility(evals, objective_sense="min", ranking_method="centered")
+    assert u.shape == (2, 3)
+    assert np.allclose(np.asarray(u[0]), [0.5, 0.0, -0.5])

@@ -1,0 +1,140 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.algorithms.functional import (
+    adam,
+    adam_ask,
+    adam_tell,
+    clipup,
+    clipup_ask,
+    clipup_tell,
+    get_functional_optimizer,
+    sgd,
+    sgd_ask,
+    sgd_tell,
+)
+
+
+def test_adam_converges_to_maximum():
+    # maximize -(x-2)^2: gradient = -2(x-2)
+    state = adam(center_init=jnp.zeros(3), center_learning_rate=0.1)
+
+    @jax.jit
+    def run(state):
+        def step(state, _):
+            x = adam_ask(state)
+            return adam_tell(state, follow_grad=-2 * (x - 2.0)), None
+
+        return jax.lax.scan(step, state, None, length=200)[0]
+
+    state = run(state)
+    assert np.allclose(np.asarray(adam_ask(state)), 2.0, atol=0.05)
+
+
+def test_clipup_velocity_clip():
+    state = clipup(center_init=jnp.zeros(2), center_learning_rate=0.1)
+    assert float(state.max_speed) == pytest.approx(0.2)
+    big_grad = jnp.array([1000.0, 0.0])
+
+    @jax.jit
+    def run(state):
+        def step(state, _):
+            return clipup_tell(state, follow_grad=big_grad), None
+
+        return jax.lax.scan(step, state, None, length=50)[0]
+
+    state = run(state)
+    # velocity normalized: after many steps the speed stays at max_speed
+    assert float(jnp.linalg.norm(state.velocity)) <= 0.2 + 1e-6
+    # center advanced in the gradient direction only
+    assert float(state.center[0]) > 0
+    assert float(state.center[1]) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_clipup_requires_lr_or_max_speed():
+    with pytest.raises(ValueError):
+        clipup(center_init=jnp.zeros(2))
+    st = clipup(center_init=jnp.zeros(2), max_speed=0.4)
+    assert float(st.center_learning_rate) == pytest.approx(0.2)
+
+
+def test_sgd_momentum():
+    state = sgd(center_init=jnp.zeros(1), center_learning_rate=0.1, momentum=0.9)
+    state = sgd_tell(state, follow_grad=jnp.ones(1))
+    assert float(state.center[0]) == pytest.approx(0.1)
+    state = sgd_tell(state, follow_grad=jnp.ones(1))
+    assert float(state.center[0]) == pytest.approx(0.1 + 0.19)
+
+
+def test_batched_optimizer():
+    # two independent Adam searches in one state
+    state = adam(center_init=jnp.zeros((2, 3)), center_learning_rate=0.1)
+    targets = jnp.array([[1.0, 1.0, 1.0], [-1.0, -1.0, -1.0]])
+
+    @jax.jit
+    def run(state):
+        def step(state, _):
+            x = adam_ask(state)
+            return adam_tell(state, follow_grad=-2 * (x - targets)), None
+
+        return jax.lax.scan(step, state, None, length=100)[0]
+
+    state = run(state)
+    assert np.allclose(np.asarray(adam_ask(state)), np.asarray(targets), atol=0.1)
+
+
+def test_registry():
+    init, ask, tell = get_functional_optimizer("clipup")
+    assert init is clipup and ask is clipup_ask and tell is clipup_tell
+    custom = (sgd, sgd_ask, sgd_tell)
+    assert get_functional_optimizer(custom) == custom
+    with pytest.raises(ValueError):
+        get_functional_optimizer("bogus")
+
+
+def test_optimizer_state_jits():
+    state = adam(center_init=jnp.zeros(4), center_learning_rate=0.05)
+
+    @jax.jit
+    def step(state):
+        x = adam_ask(state)
+        return adam_tell(state, follow_grad=-x)
+
+    for _ in range(3):
+        state = step(state)
+    assert state.center.shape == (4,)
+
+
+def test_oo_optimizers():
+    from evotorch_tpu.optimizers import SGD, Adam, ClipUp, get_optimizer_class
+
+    cu = ClipUp(solution_length=3, dtype="float32", stepsize=0.1)
+    step1 = cu.ascent(jnp.array([100.0, 0.0, 0.0]))
+    assert float(jnp.linalg.norm(step1)) == pytest.approx(0.1, abs=1e-5)
+
+    ad = Adam(solution_length=2, dtype="float32", stepsize=0.01)
+    s = ad.ascent(jnp.ones(2))
+    assert s.shape == (2,)
+    assert float(s[0]) == pytest.approx(0.01, rel=0.01)
+
+    sg = SGD(solution_length=2, dtype="float32", stepsize=0.5)
+    assert np.allclose(np.asarray(sg.ascent(jnp.ones(2))), 0.5)
+
+    assert get_optimizer_class("clipup") is ClipUp
+    factory = get_optimizer_class("adam", {"stepsize": 0.5})
+    inst = factory(solution_length=2, dtype="float32")
+    assert inst._stepsize == 0.5
+    with pytest.raises(ValueError):
+        get_optimizer_class("bogus")
+
+
+def test_optax_adapter():
+    import optax
+
+    from evotorch_tpu.optimizers import OptaxOptimizer
+
+    opt = OptaxOptimizer(optax.sgd(0.5), solution_length=2, dtype="float32")
+    step = opt.ascent(jnp.array([1.0, -1.0]))
+    assert np.allclose(np.asarray(step), [0.5, -0.5])

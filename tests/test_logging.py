@@ -1,0 +1,69 @@
+import os
+import pickle
+
+import jax.numpy as jnp
+import pytest
+
+from evotorch_tpu import Problem, vectorized
+from evotorch_tpu.algorithms.gaussian import SNES
+from evotorch_tpu.logging import PandasLogger, PicklingLogger, StdOutLogger
+
+
+@vectorized
+def sphere(xs):
+    return jnp.sum(xs**2, axis=-1)
+
+
+def make_searcher():
+    p = Problem("min", sphere, solution_length=5, initial_bounds=(-3, 3), seed=0)
+    return SNES(p, stdev_init=2.0)
+
+
+def test_stdout_logger(capsys):
+    s = make_searcher()
+    StdOutLogger(s)
+    s.run(2)
+    out = capsys.readouterr().out
+    assert "iter" in out
+    assert "mean_eval" in out
+
+
+def test_stdout_logger_interval(capsys):
+    s = make_searcher()
+    StdOutLogger(s, interval=2)
+    s.run(4)
+    out = capsys.readouterr().out
+    assert out.count("iter") == 2
+
+
+def test_pandas_logger():
+    s = make_searcher()
+    logger = PandasLogger(s)
+    s.run(5)
+    frame = logger.to_dataframe()
+    assert len(frame) == 5
+    assert "mean_eval" in frame.columns
+
+
+def test_pickling_logger(tmp_path):
+    s = make_searcher()
+    logger = PicklingLogger(s, interval=2, directory=str(tmp_path), verbose=False)
+    s.run(4)
+    assert logger.last_file_name is not None
+    payload = logger.unpickle_last_file()
+    assert "center" in payload
+    assert payload["iter"] == 4
+    # a final save fires at end_of_run
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".pickle")]
+    assert len(files) >= 2
+
+
+def test_scalar_filtering():
+    s = make_searcher()
+    logger = PandasLogger(s)
+    s.run(1)
+    row = logger._data[0]
+    # non-scalar entries (center vector, best Solution) are filtered out
+    assert "center" not in row
+    assert "best" not in row
+    assert isinstance(row["best_eval"], float)

@@ -1,0 +1,115 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu import Problem, vectorized
+from evotorch_tpu.algorithms import IPOP, MAPElites, Restart, SNES
+from evotorch_tpu.operators.real import GaussianMutation
+
+
+def test_make_feature_grid():
+    grid = MAPElites.make_feature_grid(
+        lower_bounds=[0.0, -1.0], upper_bounds=[1.0, 1.0], num_bins=[4, 3]
+    )
+    assert grid.shape == (12, 2, 2)
+    # outermost bins extend to +-inf
+    assert float(grid[0, 0, 0]) == -np.inf
+    assert float(grid[-1, 0, 1]) == np.inf
+    # cell bounds are ordered
+    assert bool(jnp.all(grid[:, :, 0] <= grid[:, :, 1]))
+
+
+def test_mapelites_fills_archive():
+    # fitness = sphere; feature = x[0] (first decision variable)
+    @vectorized
+    def fit_and_feature(xs):
+        return jnp.sum(xs**2, axis=-1)[:, None], xs[:, :1]
+
+    p = Problem(
+        "min",
+        fit_and_feature,
+        solution_length=3,
+        initial_bounds=(-2, 2),
+        eval_data_length=1,
+        seed=0,
+    )
+    grid = MAPElites.make_feature_grid([-2.0], [2.0], num_bins=[8])
+    searcher = MAPElites(
+        p,
+        operators=[GaussianMutation(p, stdev=0.5)],
+        feature_grid=grid,
+    )
+    searcher.run(10)
+    assert len(searcher.population) == 8
+    filled = np.asarray(searcher.filled)
+    assert filled.sum() >= 4  # most cells found an occupant
+    # each filled cell's occupant feature lies within the cell bounds
+    evals = np.asarray(searcher.population.evals)
+    g = np.asarray(grid)
+    for i in range(8):
+        if filled[i]:
+            feat = evals[i, 1]
+            assert g[i, 0, 0] <= feat <= g[i, 0, 1]
+
+
+@vectorized
+def sphere(xs):
+    return jnp.sum(xs**2, axis=-1)
+
+
+class TerminatingSNES(SNES):
+    @property
+    def is_terminated(self):
+        return self.step_count > 0 and self.step_count % 5 == 0
+
+
+def test_restart_reinstantiates():
+    p = Problem("min", sphere, solution_length=4, initial_bounds=(-3, 3), seed=0)
+    r = Restart(p, TerminatingSNES, {"stdev_init": 1.0})
+    r.run(11)
+    assert r.num_restarts >= 3
+    assert r.status["num_restarts"] == r.num_restarts
+
+
+def test_ipop_grows_popsize():
+    p = Problem("min", sphere, solution_length=4, initial_bounds=(-3, 3), seed=0)
+
+    from evotorch_tpu.algorithms import CEM
+
+    r = IPOP(
+        p,
+        CEM,
+        {"popsize": 10, "parenthood_ratio": 0.5, "stdev_init": 1.0},
+        min_fitness_stdev=1e-3,
+        popsize_multiplier=2,
+    )
+    r.run(60)
+    if r.num_restarts > 1:
+        assert r._algorithm_args["popsize"] > 10
+
+
+def test_functional_cmaes():
+    import jax
+
+    from evotorch_tpu.algorithms.functional.funccmaes import cmaes, cmaes_ask, cmaes_tell
+
+    state = cmaes(
+        center_init=jnp.full((5,), 3.0),
+        stdev_init=1.0,
+        objective_sense="min",
+        popsize=12,
+    )
+
+    @jax.jit
+    def run(state, key):
+        def gen(state, key):
+            state, xs = cmaes_ask(key, state)
+            fits = jnp.sum(xs**2, axis=-1)
+            return cmaes_tell(state, xs, fits), jnp.min(fits)
+
+        return jax.lax.scan(gen, state, jax.random.split(key, 120))
+
+    state, best = run(state, jax.random.key(0))
+    assert float(best[-1]) < 0.05
+    assert float(best[-1]) < float(best[0])
+    assert int(state.iteration) == 120

@@ -104,9 +104,8 @@ def test_tensormaker():
     g = o.make_gaussian(num_solutions=4, center=2.0, stdev=0.0)
     assert np.allclose(np.asarray(g), 2.0)
     sym = o.make_gaussian(num_solutions=4, symmetric=True)
-    assert np.allclose(np.asarray(sym[:2]), -np.asarray(sym[2:][::-1]) * 1.0) or np.allclose(
-        np.asarray(sym[:2]), -np.asarray(sym[2:])
-    )
+    # antithetic pairs are interleaved: [+e0, -e0, +e1, -e1]
+    assert np.allclose(np.asarray(sym[0::2]), -np.asarray(sym[1::2]))
     ri = o.make_randint(num_solutions=6, n=3)
     assert int(jnp.min(ri)) >= 0 and int(jnp.max(ri)) < 3
 
@@ -136,3 +135,13 @@ def test_tensormaker_eval_dtype():
     assert o.make_zeros(num_solutions=2).dtype == jnp.bfloat16
     assert o.make_zeros(num_solutions=2, use_eval_dtype=True).dtype == jnp.float32
     assert o.make_uniform(num_solutions=2, use_eval_dtype=True).dtype == jnp.float32
+
+
+def test_ensure_array_object_scalar_payloads():
+    from evotorch_tpu.tools import ObjectArray
+
+    out = misc.ensure_array_length_and_dtype(5, 3, object)
+    assert isinstance(out, ObjectArray) and list(out) == [5, 5, 5]
+    payload = {"a": 1}
+    out = misc.ensure_array_length_and_dtype(payload, 2, object)
+    assert out[0]["a"] == 1 and out[1]["a"] == 1
