@@ -1,0 +1,137 @@
+"""Benchmark: PGPE + fully-vectorized neuroevolution rollout throughput.
+
+The driver runs this on real TPU hardware and records the single JSON line
+printed to stdout. Metric: environment steps per second through the flagship
+path — ``run_vectorized_rollout`` (one jitted program containing the whole
+population x env x time loop) driven by PGPE, popsize 10k, MLP policy on the
+pure-JAX Swimmer2D locomotion env (the stand-in for Brax Humanoid, which is
+not installed in this image; see BASELINE.md north star: >1M env-steps/sec).
+
+``vs_baseline`` = env_steps_per_sec / 1_000_000 (the north-star target).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def _tpu_healthy() -> bool:
+    """Probe backend init in a subprocess: the axon plugin can hang forever
+    when its tunnel is unhealthy, which must not stall the benchmark driver."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            timeout=120,
+            capture_output=True,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    use_cpu = not _tpu_healthy()
+    if use_cpu:
+        print("TPU backend unhealthy; falling back to CPU", file=sys.stderr)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+
+    if use_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    from evotorch_tpu.algorithms.functional import pgpe, pgpe_ask, pgpe_tell
+    from evotorch_tpu.envs import Swimmer2D
+    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+    from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+    from evotorch_tpu.neuroevolution.net.vecrl import run_vectorized_rollout
+
+    popsize = int(os.environ.get("BENCH_POPSIZE", 10_000))
+    episode_length = int(os.environ.get("BENCH_EPISODE_LENGTH", 200))
+    generations = int(os.environ.get("BENCH_GENERATIONS", 3))
+
+    env = Swimmer2D(n_links=6)
+    net = (
+        Linear(env.observation_size, 64)
+        >> Tanh()
+        >> Linear(64, 64)
+        >> Tanh()
+        >> Linear(64, env.action_size)
+    )
+    policy = FlatParamsPolicy(net)
+    print(
+        f"devices={jax.devices()} popsize={popsize} params={policy.parameter_count} "
+        f"episode_length={episode_length}",
+        file=sys.stderr,
+    )
+
+    stats = RunningNorm(env.observation_size).stats
+    state = pgpe(
+        center_init=jnp.zeros(policy.parameter_count, dtype=jnp.float32),
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        objective_sense="max",
+        stdev_init=0.1,
+    )
+
+    def generation(state, key):
+        k1, k2 = jax.random.split(key)
+        values = pgpe_ask(k1, state, popsize=popsize)
+        result = run_vectorized_rollout(
+            env,
+            policy,
+            values,
+            k2,
+            stats,
+            num_episodes=1,
+            episode_length=episode_length,
+        )
+        state = pgpe_tell(state, values, result.scores)
+        return state, result.total_steps, result.scores
+
+    gen_jit = jax.jit(generation)
+
+    key = jax.random.key(0)
+    # warmup/compile
+    key, sub = jax.random.split(key)
+    state, steps, scores = gen_jit(state, sub)
+    jax.block_until_ready(scores)
+    print(f"compiled; warmup steps={int(steps)}", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    total_steps = 0
+    for _ in range(generations):
+        key, sub = jax.random.split(key)
+        state, steps, scores = gen_jit(state, sub)
+        jax.block_until_ready(scores)
+        total_steps += int(steps)
+    elapsed = time.perf_counter() - t0
+
+    steps_per_sec = total_steps / elapsed
+    print(
+        f"{generations} generations, {total_steps} env-steps in {elapsed:.2f}s; "
+        f"mean score {float(jnp.mean(scores)):.3f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "pgpe_vectorized_rollout_env_steps_per_sec",
+                "value": round(steps_per_sec, 1),
+                "unit": "env_steps/sec",
+                "vs_baseline": round(steps_per_sec / 1_000_000, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -10,7 +10,7 @@ Package entry parity: reference ``src/evotorch/__init__.py:29-38`` re-exports
 ``Problem, Solution, SolutionBatch, ProblemBoundEvaluator`` and subpackages.
 """
 
-from . import decorators, distributions, logging, operators, optimizers, parallel, tools
+from . import decorators, distributions, envs, logging, neuroevolution, operators, optimizers, parallel, tools
 from .core import Problem, ProblemBoundEvaluator, Solution, SolutionBatch, SolutionBatchPieces
 from .decorators import expects_ndim, on_aux_device, on_device, pass_info, rowwise, vectorized
 
@@ -22,7 +22,9 @@ __all__ = [
     "SolutionBatchPieces",
     "decorators",
     "distributions",
+    "envs",
     "logging",
+    "neuroevolution",
     "operators",
     "optimizers",
     "parallel",

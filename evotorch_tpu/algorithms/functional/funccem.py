@@ -18,6 +18,7 @@ from ...distributions import SeparableGaussian
 from ...tools.misc import modify_vector, stdev_from_radius
 from ...tools.pytree import pytree_dataclass, replace, static_field
 from ...tools.ranking import rank
+from .misc import as_vector_like
 
 __all__ = ["CEMState", "cem", "cem_ask", "cem_tell"]
 
@@ -31,15 +32,6 @@ class CEMState:
     stdev_max_change: jnp.ndarray
     parenthood_ratio: float = static_field()
     maximize: bool = static_field()
-
-
-def _as_vector_like(x, center: jnp.ndarray, default: float) -> jnp.ndarray:
-    if x is None:
-        x = default
-    x = jnp.asarray(x, dtype=center.dtype)
-    if x.ndim == 0:
-        return jnp.broadcast_to(x, center.shape[-1:])
-    return x
 
 
 def cem(
@@ -61,13 +53,13 @@ def cem(
         raise ValueError("Exactly one of stdev_init / radius_init must be provided")
     if radius_init is not None:
         stdev_init = stdev_from_radius(float(radius_init), center_init.shape[-1])
-    stdev = _as_vector_like(stdev_init, center_init, 0.0)
+    stdev = as_vector_like(stdev_init, center_init, 0.0)
     return CEMState(
         center=center_init,
         stdev=jnp.broadcast_to(stdev, center_init.shape),
-        stdev_min=_as_vector_like(stdev_min, center_init, 0.0),
-        stdev_max=_as_vector_like(stdev_max, center_init, float("inf")),
-        stdev_max_change=_as_vector_like(stdev_max_change, center_init, float("inf")),
+        stdev_min=as_vector_like(stdev_min, center_init, 0.0),
+        stdev_max=as_vector_like(stdev_max, center_init, float("inf")),
+        stdev_max_change=as_vector_like(stdev_max_change, center_init, float("inf")),
         parenthood_ratio=float(parenthood_ratio),
         maximize=(objective_sense == "max"),
     )

@@ -19,7 +19,7 @@ from ...distributions import (
 )
 from ...tools.misc import modify_vector, stdev_from_radius
 from ...tools.pytree import pytree_dataclass, replace, static_field
-from .misc import get_functional_optimizer
+from .misc import as_vector_like, get_functional_optimizer
 
 __all__ = ["PGPEState", "pgpe", "pgpe_ask", "pgpe_tell"]
 
@@ -36,15 +36,6 @@ class PGPEState:
     ranking_method: str = static_field()
     maximize: bool = static_field()
     symmetric: bool = static_field()
-
-
-def _as_vector_like(x, center: jnp.ndarray, default: float) -> jnp.ndarray:
-    if x is None:
-        x = default
-    x = jnp.asarray(x, dtype=center.dtype)
-    if x.ndim == 0:
-        return jnp.broadcast_to(x, center.shape[-1:])
-    return x
 
 
 def _dist_class(symmetric: bool):
@@ -80,7 +71,7 @@ def pgpe(
         raise ValueError("Exactly one of stdev_init / radius_init must be provided")
     if radius_init is not None:
         stdev_init = stdev_from_radius(float(radius_init), center_init.shape[-1])
-    stdev = jnp.broadcast_to(_as_vector_like(stdev_init, center_init, 0.0), center_init.shape)
+    stdev = jnp.broadcast_to(as_vector_like(stdev_init, center_init, 0.0), center_init.shape)
 
     opt_init, _, _ = get_functional_optimizer(optimizer)
     optimizer_state = opt_init(
@@ -93,9 +84,9 @@ def pgpe(
         optimizer_state=optimizer_state,
         stdev=stdev,
         stdev_learning_rate=jnp.asarray(stdev_learning_rate, dtype=center_init.dtype),
-        stdev_min=_as_vector_like(stdev_min, center_init, 0.0),
-        stdev_max=_as_vector_like(stdev_max, center_init, float("inf")),
-        stdev_max_change=_as_vector_like(stdev_max_change, center_init, float("inf")),
+        stdev_min=as_vector_like(stdev_min, center_init, 0.0),
+        stdev_max=as_vector_like(stdev_max, center_init, float("inf")),
+        stdev_max_change=as_vector_like(stdev_max_change, center_init, float("inf")),
         optimizer=optimizer,
         ranking_method=str(ranking_method),
         maximize=(objective_sense == "max"),

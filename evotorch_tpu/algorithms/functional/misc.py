@@ -1,10 +1,25 @@
-"""Functional-optimizer registry (reference ``algorithms/functional/misc.py:26-76``)."""
+"""Functional-optimizer registry (reference ``algorithms/functional/misc.py:26-76``)
+and small shared helpers for the functional algorithms."""
 
 from __future__ import annotations
 
 from typing import Iterable, NamedTuple, Union
 
-__all__ = ["OptimizerFunctions", "get_functional_optimizer"]
+import jax.numpy as jnp
+
+__all__ = ["OptimizerFunctions", "get_functional_optimizer", "as_vector_like"]
+
+
+def as_vector_like(x, center: jnp.ndarray, default: float) -> jnp.ndarray:
+    """Coerce a scalar/None/vector hyperparameter into a vector matching the
+    center's trailing dimension (the reference's ``as_vector_like_center``,
+    ``funcpgpe.py:244-258``)."""
+    if x is None:
+        x = default
+    x = jnp.asarray(x, dtype=center.dtype)
+    if x.ndim == 0:
+        return jnp.broadcast_to(x, center.shape[-1:])
+    return x
 
 
 class OptimizerFunctions(NamedTuple):

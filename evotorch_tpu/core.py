@@ -348,6 +348,18 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         if self._vectorized and self._objective_func is not None:
             result = self._objective_func(batch.values)
             batch.set_evals(*self._split_eval_outputs(result))
+        elif self._objective_func is not None and not is_dtype_object(self._dtype):
+            # per-solution loop, but accumulate host-side and scatter once —
+            # avoids rebuilding the (N, W) eval matrix N times
+            rows = []
+            width = self.num_objectives + self._eval_data_length
+            for i in range(len(batch)):
+                result = self._objective_func(batch.values[i])
+                row = np.atleast_1d(np.asarray(result, dtype=np.float64))
+                if row.shape[0] < width:
+                    row = np.concatenate([row, np.full(width - row.shape[0], np.nan)])
+                rows.append(row)
+            batch.set_evals(jnp.asarray(np.stack(rows), dtype=self._eval_dtype))
         else:
             for sln in batch:
                 self._evaluate(sln)
@@ -401,17 +413,20 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         self._refresh_status_from_stats()
 
     def _refresh_status_from_stats(self):
-        if self._best is not None and self._best[0] is not None:
-            if len(self._senses) == 1:
+        if self._best is None:
+            return
+        if len(self._senses) == 1:
+            if self._best[0] is not None:
                 self._status["best"] = self._best[0]
                 self._status["worst"] = self._worst[0]
                 self._status["best_eval"] = float(np.asarray(self._best[0].evals)[0])
                 self._status["worst_eval"] = float(np.asarray(self._worst[0].evals)[0])
-            else:
-                for i in range(len(self._senses)):
-                    if self._best[i] is not None:
-                        self._status[f"obj{i}_best"] = self._best[i]
-                        self._status[f"obj{i}_worst"] = self._worst[i]
+        else:
+            # each objective publishes independently (one may be all-NaN so far)
+            for i in range(len(self._senses)):
+                if self._best[i] is not None:
+                    self._status[f"obj{i}_best"] = self._best[i]
+                    self._status[f"obj{i}_worst"] = self._worst[i]
 
     # ------------------------------------------------ sharded evaluation API
     def use_sharded_evaluation(self, mesh=None, *, axis_name: str = "pop", donate: bool = False):
@@ -888,7 +903,14 @@ class SolutionBatch(Serializable, RecursivePrintable):
         return self
 
     def __getitem__(self, i) -> Union["Solution", "SolutionBatch"]:
-        if isinstance(i, slice) or (hasattr(i, "__len__") and not isinstance(i, str)):
+        if isinstance(i, slice):
+            return SolutionBatch(slice_of=(self, i))
+        # 0-d arrays (e.g. the result of argbest) index a single Solution
+        if hasattr(i, "ndim"):
+            if i.ndim == 0:
+                return Solution(self, int(i))
+            return SolutionBatch(slice_of=(self, i))
+        if hasattr(i, "__len__") and not isinstance(i, str):
             return SolutionBatch(slice_of=(self, i))
         return Solution(self, int(i))
 

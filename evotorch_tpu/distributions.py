@@ -48,9 +48,6 @@ __all__ = [
     "make_functional_grad_estimator",
 ]
 
-_STRING_PARAMETERS = {"divide_mu_grad_by", "divide_sigma_grad_by"}
-
-
 class Distribution(TensorMakerMixin, Serializable, RecursivePrintable):
     """Base class for search distributions (reference ``distributions.py:40``)."""
 

@@ -1,0 +1,51 @@
+"""Brax adapter (import-gated: brax is not baked into this image).
+
+Parity: the reference's ``VectorEnvFromBrax`` (``net/vecrl.py:1366-1490``)
+wraps brax envs with jitted reset/step and dlpack conversion to torch. Here
+no conversion is needed — a brax env already satisfies our pure protocol; the
+adapter only reshapes its API (brax State -> EnvState, truncation handling).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..tools.pytree import replace
+from .base import Env, EnvState, Space
+
+__all__ = ["BraxEnvAdapter"]
+
+
+class BraxEnvAdapter(Env):
+    def __init__(self, env_name: str, *, episode_length: int = 1000, **brax_kwargs):
+        try:
+            import brax.envs as brax_envs
+        except ImportError as e:
+            raise ImportError(
+                "brax is not installed in this environment; use the pure-JAX "
+                "envs (cartpole/pendulum/acrobot/swimmer/...) instead"
+            ) from e
+        self._env = brax_envs.get_environment(env_name, **brax_kwargs)
+        self.max_episode_steps = int(episode_length)
+        obs_size = int(self._env.observation_size)
+        act_size = int(self._env.action_size)
+        self.observation_space = Space(shape=(obs_size,))
+        self.action_space = Space(
+            shape=(act_size,), lb=-jnp.ones(act_size), ub=jnp.ones(act_size)
+        )
+
+    def reset(self, key) -> Tuple[EnvState, jnp.ndarray]:
+        key, sub = jax.random.split(key)
+        brax_state = self._env.reset(sub)
+        state = EnvState(obs_state=brax_state, t=jnp.zeros((), jnp.int32), key=key)
+        return state, brax_state.obs
+
+    def step(self, state: EnvState, action):
+        brax_state = self._env.step(state.obs_state, jnp.asarray(action))
+        t = state.t + 1
+        done = (brax_state.done > 0) | (t >= self.max_episode_steps)
+        new_state = replace(state, obs_state=brax_state, t=t)
+        return new_state, brax_state.obs, brax_state.reward, done

@@ -1,0 +1,286 @@
+"""Classic-control environments in pure JAX.
+
+Dynamics follow the standard gym formulations (CartPole: Barto, Sutton &
+Anderson 1983; Pendulum; Acrobot: Sutton 1996; MountainCarContinuous: Moore
+1990) so evolved policies are comparable to policies evolved on gym's
+versions. Each env's ``reset``/``step`` is pure and jittable; a whole
+``(population x env x time)`` rollout compiles into one XLA program (the
+TPU-native replacement for the reference's dlpack torch<->jax ping-pong,
+SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..tools.pytree import replace
+from .base import Env, EnvState, Space
+
+__all__ = ["CartPole", "Pendulum", "Acrobot", "MountainCarContinuous", "Swimmer2D"]
+
+
+class CartPole(Env):
+    """CartPole-v1 dynamics. ``continuous_actions=True`` exposes a Box(-1, 1)
+    action mapped to force direction (for policies without argmax heads)."""
+
+    max_episode_steps = 500
+
+    def __init__(self, *, continuous_actions: bool = False):
+        self.continuous = bool(continuous_actions)
+        self.observation_space = Space(shape=(4,))
+        if self.continuous:
+            self.action_space = Space(shape=(1,), lb=jnp.array([-1.0]), ub=jnp.array([1.0]))
+        else:
+            self.action_space = Space(shape=(), n=2)
+
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.total_mass = self.masspole + self.masscart
+        self.length = 0.5
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * jnp.pi / 360
+        self.x_threshold = 2.4
+
+    def reset(self, key) -> Tuple[EnvState, jnp.ndarray]:
+        key, sub = jax.random.split(key)
+        obs = jax.random.uniform(sub, (4,), minval=-0.05, maxval=0.05)
+        return EnvState(obs_state=obs, t=jnp.zeros((), jnp.int32), key=key), obs
+
+    def step(self, state: EnvState, action):
+        x, x_dot, theta, theta_dot = state.obs_state
+        if self.continuous:
+            force = self.force_mag * jnp.clip(jnp.reshape(action, ())[None][0], -1.0, 1.0)
+        else:
+            act = jnp.reshape(action, ()).astype(jnp.int32)
+            force = jnp.where(act == 1, self.force_mag, -self.force_mag)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        temp = (force + self.polemass_length * theta_dot**2 * sintheta) / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / self.total_mass)
+        )
+        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        obs = jnp.stack([x, x_dot, theta, theta_dot])
+        t = state.t + 1
+        done = (
+            (jnp.abs(x) > self.x_threshold)
+            | (jnp.abs(theta) > self.theta_threshold)
+            | (t >= self.max_episode_steps)
+        )
+        reward = jnp.ones(())
+        return replace(state, obs_state=obs, t=t), obs, reward, done
+
+
+class Pendulum(Env):
+    """Pendulum-v1 dynamics: swing-up with torque penalty."""
+
+    max_episode_steps = 200
+
+    def __init__(self):
+        self.observation_space = Space(shape=(3,))
+        self.action_space = Space(shape=(1,), lb=jnp.array([-2.0]), ub=jnp.array([2.0]))
+        self.max_speed = 8.0
+        self.max_torque = 2.0
+        self.dt = 0.05
+        self.g = 10.0
+        self.m = 1.0
+        self.l = 1.0
+
+    def _obs(self, th, thdot):
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot])
+
+    def reset(self, key):
+        key, sub = jax.random.split(key)
+        th = jax.random.uniform(sub, (), minval=-jnp.pi, maxval=jnp.pi)
+        key, sub = jax.random.split(key)
+        thdot = jax.random.uniform(sub, (), minval=-1.0, maxval=1.0)
+        state = EnvState(obs_state=jnp.stack([th, thdot]), t=jnp.zeros((), jnp.int32), key=key)
+        return state, self._obs(th, thdot)
+
+    def step(self, state, action):
+        th, thdot = state.obs_state
+        u = jnp.clip(jnp.reshape(action, ()), -self.max_torque, self.max_torque)
+        norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        newthdot = thdot + (3 * self.g / (2 * self.l) * jnp.sin(th) + 3.0 / (self.m * self.l**2) * u) * self.dt
+        newthdot = jnp.clip(newthdot, -self.max_speed, self.max_speed)
+        newth = th + newthdot * self.dt
+        t = state.t + 1
+        done = t >= self.max_episode_steps
+        new_state = replace(state, obs_state=jnp.stack([newth, newthdot]), t=t)
+        return new_state, self._obs(newth, newthdot), -cost, done
+
+
+class Acrobot(Env):
+    """Acrobot-v1 dynamics (two-link underactuated swing-up)."""
+
+    max_episode_steps = 500
+
+    def __init__(self):
+        self.observation_space = Space(shape=(6,))
+        self.action_space = Space(shape=(), n=3)
+        self.dt = 0.2
+        self.link_length_1 = 1.0
+        self.link_length_2 = 1.0
+        self.link_mass_1 = 1.0
+        self.link_mass_2 = 1.0
+        self.link_com_pos_1 = 0.5
+        self.link_com_pos_2 = 0.5
+        self.link_moi = 1.0
+        self.max_vel_1 = 4 * jnp.pi
+        self.max_vel_2 = 9 * jnp.pi
+
+    def _obs(self, s):
+        th1, th2, dth1, dth2 = s
+        return jnp.stack([jnp.cos(th1), jnp.sin(th1), jnp.cos(th2), jnp.sin(th2), dth1, dth2])
+
+    def reset(self, key):
+        key, sub = jax.random.split(key)
+        s = jax.random.uniform(sub, (4,), minval=-0.1, maxval=0.1)
+        return EnvState(obs_state=s, t=jnp.zeros((), jnp.int32), key=key), self._obs(s)
+
+    def _dynamics(self, s_augmented):
+        m1, m2 = self.link_mass_1, self.link_mass_2
+        l1 = self.link_length_1
+        lc1, lc2 = self.link_com_pos_1, self.link_com_pos_2
+        I1 = I2 = self.link_moi
+        g = 9.8
+        th1, th2, dth1, dth2, a = s_augmented
+        d1 = m1 * lc1**2 + m2 * (l1**2 + lc2**2 + 2 * l1 * lc2 * jnp.cos(th2)) + I1 + I2
+        d2 = m2 * (lc2**2 + l1 * lc2 * jnp.cos(th2)) + I2
+        phi2 = m2 * lc2 * g * jnp.cos(th1 + th2 - jnp.pi / 2)
+        phi1 = (
+            -m2 * l1 * lc2 * dth2**2 * jnp.sin(th2)
+            - 2 * m2 * l1 * lc2 * dth2 * dth1 * jnp.sin(th2)
+            + (m1 * lc1 + m2 * l1) * g * jnp.cos(th1 - jnp.pi / 2)
+            + phi2
+        )
+        ddth2 = (a + d2 / d1 * phi1 - m2 * l1 * lc2 * dth1**2 * jnp.sin(th2) - phi2) / (
+            m2 * lc2**2 + I2 - d2**2 / d1
+        )
+        ddth1 = -(d2 * ddth2 + phi1) / d1
+        return jnp.stack([dth1, dth2, ddth1, ddth2, jnp.zeros(())])
+
+    def step(self, state, action):
+        act = jnp.reshape(action, ()).astype(jnp.int32)
+        torque = act.astype(jnp.float32) - 1.0  # {-1, 0, +1}
+        s_augmented = jnp.concatenate([state.obs_state, torque[None]])
+        # rk4 integration over dt
+        dt = self.dt
+
+        def deriv(y):
+            return self._dynamics(y)
+
+        k1 = deriv(s_augmented)
+        k2 = deriv(s_augmented + dt / 2 * k1)
+        k3 = deriv(s_augmented + dt / 2 * k2)
+        k4 = deriv(s_augmented + dt * k3)
+        ns = s_augmented + dt / 6 * (k1 + 2 * k2 + 2 * k3 + k4)
+        th1 = ((ns[0] + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        th2 = ((ns[1] + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        dth1 = jnp.clip(ns[2], -self.max_vel_1, self.max_vel_1)
+        dth2 = jnp.clip(ns[3], -self.max_vel_2, self.max_vel_2)
+        s = jnp.stack([th1, th2, dth1, dth2])
+        t = state.t + 1
+        solved = -jnp.cos(th1) - jnp.cos(th2 + th1) > 1.0
+        done = solved | (t >= self.max_episode_steps)
+        reward = jnp.where(solved, 0.0, -1.0)
+        return replace(state, obs_state=s, t=t), self._obs(s), reward, done
+
+
+class MountainCarContinuous(Env):
+    """MountainCarContinuous-v0 dynamics."""
+
+    max_episode_steps = 999
+
+    def __init__(self):
+        self.observation_space = Space(shape=(2,))
+        self.action_space = Space(shape=(1,), lb=jnp.array([-1.0]), ub=jnp.array([1.0]))
+        self.min_position = -1.2
+        self.max_position = 0.6
+        self.max_speed = 0.07
+        self.goal_position = 0.45
+        self.power = 0.0015
+
+    def reset(self, key):
+        key, sub = jax.random.split(key)
+        position = jax.random.uniform(sub, (), minval=-0.6, maxval=-0.4)
+        s = jnp.stack([position, jnp.zeros(())])
+        return EnvState(obs_state=s, t=jnp.zeros((), jnp.int32), key=key), s
+
+    def step(self, state, action):
+        position, velocity = state.obs_state
+        force = jnp.clip(jnp.reshape(action, ()), -1.0, 1.0)
+        velocity = velocity + force * self.power - 0.0025 * jnp.cos(3 * position)
+        velocity = jnp.clip(velocity, -self.max_speed, self.max_speed)
+        position = jnp.clip(position + velocity, self.min_position, self.max_position)
+        velocity = jnp.where((position <= self.min_position) & (velocity < 0), 0.0, velocity)
+        s = jnp.stack([position, velocity])
+        t = state.t + 1
+        goal = position >= self.goal_position
+        done = goal | (t >= self.max_episode_steps)
+        reward = jnp.where(goal, 100.0, 0.0) - 0.1 * force**2
+        return replace(state, obs_state=s, t=t), s, reward, done
+
+
+class Swimmer2D(Env):
+    """A light n-link planar swimmer: a chain of links in a viscous fluid,
+    rewarded for forward velocity of its head. A MuJoCo-free locomotion task
+    with MXU-friendly per-step linear algebra — the benchmark stand-in for
+    Brax-style locomotion (the reference uses Brax envs here,
+    ``vecgymne.py:496-570``; Brax is not installed in this image)."""
+
+    max_episode_steps = 1000
+
+    def __init__(self, n_links: int = 3):
+        self.n_links = int(n_links)
+        # obs: link angles (n), angular velocities (n), head velocity (2)
+        self.observation_space = Space(shape=(2 * self.n_links + 2,))
+        n_act = self.n_links - 1
+        self.action_space = Space(
+            shape=(n_act,), lb=-jnp.ones(n_act), ub=jnp.ones(n_act)
+        )
+        self.dt = 0.04
+        self.viscosity = 0.1
+        self.torque_scale = 1.0
+
+    def reset(self, key):
+        key, sub = jax.random.split(key)
+        n = self.n_links
+        angles = jax.random.uniform(sub, (n,), minval=-0.1, maxval=0.1)
+        omega = jnp.zeros(n)
+        head_vel = jnp.zeros(2)
+        s = jnp.concatenate([angles, omega, head_vel])
+        return EnvState(obs_state=s, t=jnp.zeros((), jnp.int32), key=key), s
+
+    def step(self, state, action):
+        n = self.n_links
+        s = state.obs_state
+        angles, omega, head_vel = s[:n], s[n : 2 * n], s[2 * n :]
+        torque = self.torque_scale * jnp.clip(jnp.reshape(action, (n - 1,)), -1.0, 1.0)
+        # joint torques act on adjacent links with opposite signs
+        joint_torque = jnp.zeros(n).at[:-1].add(torque).at[1:].add(-torque)
+        # viscous drag opposes angular velocity; lateral drag on each link
+        # couples into forward thrust when links oscillate out of phase
+        alpha = joint_torque - self.viscosity * 30.0 * omega
+        omega = omega + self.dt * alpha
+        angles = angles + self.dt * omega
+        # net thrust: sum of lateral link motions projected on the body axis
+        lateral = jnp.sin(angles) * omega
+        thrust = jnp.sum(lateral * jnp.cos(angles)) / n
+        head_vel = 0.9 * head_vel + self.dt * jnp.stack([jnp.abs(thrust), thrust])
+        s = jnp.concatenate([angles, omega, head_vel])
+        t = state.t + 1
+        reward = head_vel[0] - 0.0001 * jnp.sum(torque**2)
+        done = t >= self.max_episode_steps
+        return replace(state, obs_state=s, t=t), s, reward, done

@@ -1,0 +1,55 @@
+"""Environment registry.
+
+Parity: the reference resolves env strings like ``"gym::Humanoid-v4"`` or
+``"brax::humanoid"`` (``vecgymne.py:496-570``, ``net/vecrl.py:764-860``).
+Here plain names resolve to the pure-JAX envs; ``"brax::<name>"`` adapts a
+brax env when brax is importable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from .base import Env
+
+__all__ = ["make_env", "register_env"]
+
+_REGISTRY: Dict[str, Callable[..., Env]] = {}
+
+
+def register_env(name: str, factory: Callable[..., Env]):
+    _REGISTRY[name.lower()] = factory
+
+
+def make_env(name: str, **kwargs) -> Env:
+    """Instantiate an environment by name.
+
+    Plain names (``"cartpole"``, ``"pendulum"``, ``"acrobot"``,
+    ``"mountain_car_continuous"``, ``"swimmer"``) resolve to the pure-JAX
+    suite. ``"brax::<env>"`` adapts brax (requires brax installed)."""
+    if name.startswith("brax::"):
+        from .braxenv import BraxEnvAdapter
+
+        return BraxEnvAdapter(name[len("brax::") :], **kwargs)
+    key = name.lower().replace("-", "_")
+    # tolerate gym-style version suffixes: "CartPole-v1" -> "cartpole"
+    for suffix in ("_v0", "_v1", "_v2", "_v3", "_v4", "_v5"):
+        if key.endswith(suffix):
+            key = key[: -len(suffix)]
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown environment: {name!r} (known: {sorted(_REGISTRY)})")
+    return _REGISTRY[key](**kwargs)
+
+
+def _register_defaults():
+    from .classic import Acrobot, CartPole, MountainCarContinuous, Pendulum, Swimmer2D
+
+    register_env("cartpole", CartPole)
+    register_env("pendulum", Pendulum)
+    register_env("acrobot", Acrobot)
+    register_env("mountain_car_continuous", MountainCarContinuous)
+    register_env("mountaincarcontinuous", MountainCarContinuous)
+    register_env("swimmer", Swimmer2D)
+
+
+_register_defaults()

@@ -1,0 +1,21 @@
+"""Neuroevolution problem layers (L6).
+
+Parity: reference ``neuroevolution/__init__.py`` — ``NEProblem``, ``GymNE``,
+``VecGymNE``, ``SupervisedNE`` plus the ``net`` subpackage.
+"""
+
+from . import net
+from .gymne import GymNE
+from .neproblem import BaseNEProblem, NEProblem
+from .supervisedne import SupervisedNE
+from .vecneproblem import VecGymNE, VecNE
+
+__all__ = [
+    "net",
+    "GymNE",
+    "BaseNEProblem",
+    "NEProblem",
+    "SupervisedNE",
+    "VecGymNE",
+    "VecNE",
+]

@@ -1,0 +1,246 @@
+"""Classical (non-vectorized) gym RL neuroevolution.
+
+Parity: reference ``neuroevolution/gymne.py:64-730`` (``GymNE``): one
+gymnasium env, sequential ``_rollout`` loops (``gymne.py:361-414``), online
+observation normalization via ``RunningStat`` (``gymne.py:524-573`` — the
+actor delta-sync becomes a local update here; multi-device users should use
+``VecNE`` instead), interaction/episode counters feeding adaptive popsize
+(``gymne.py:594-595``), ``decrease_rewards_by`` / ``alive_bonus_schedule`` /
+``action_noise_stdev`` / ``episode_length``, discrete-action argmax
+(``gymne.py:343-347``), ``to_policy`` (``gymne.py:646-672``),
+``save_solution`` (``gymne.py:674-724``), ``visualize`` (``gymne.py:477``).
+
+This class is deliberately host-side: it exists for parity with gym-API
+environments and for debugging policies; the TPU-native throughput path is
+``VecNE`` over pure-JAX envs.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import SolutionBatch
+from .neproblem import NEProblem
+from .net.layers import Module
+from .net.rl import alive_bonus_for_step, reset_env, take_step_in_env
+from .net.runningnorm import RunningStat
+
+__all__ = ["GymNE"]
+
+
+class GymNE(NEProblem):
+    def __init__(
+        self,
+        env: Optional[Union[str, Callable]] = None,
+        network: Optional[Union[str, Module, Callable]] = None,
+        *,
+        env_name: Optional[str] = None,
+        network_args: Optional[dict] = None,
+        env_config: Optional[dict] = None,
+        observation_normalization: bool = False,
+        num_episodes: int = 1,
+        episode_length: Optional[int] = None,
+        decrease_rewards_by: Optional[float] = None,
+        alive_bonus_schedule: Optional[tuple] = None,
+        action_noise_stdev: Optional[float] = None,
+        initial_bounds=(-0.00001, 0.00001),
+        seed: Optional[int] = None,
+        num_actors=None,
+        **kwargs,
+    ):
+        if env is None and env_name is None:
+            raise ValueError("Provide `env` (or the legacy `env_name`)")
+        self._env_spec = env if env is not None else env_name
+        self._env_config = dict(env_config or {})
+        self._gym_env = None
+        self._observation_normalization = bool(observation_normalization)
+        self._num_episodes = int(num_episodes)
+        self._episode_length = episode_length
+        self._decrease_rewards_by = 0.0 if decrease_rewards_by is None else float(decrease_rewards_by)
+        self._alive_bonus_schedule = alive_bonus_schedule
+        self._action_noise_stdev = action_noise_stdev
+        self._obs_stats = RunningStat()
+        self._interaction_count = 0
+        self._episode_count = 0
+
+        self._make_gym_env()  # early, so network constants are available
+
+        super().__init__(
+            "max",
+            network,
+            network_args=network_args,
+            initial_bounds=initial_bounds,
+            seed=seed,
+            num_actors=num_actors,
+            vectorized_network_eval=False,
+            **kwargs,
+        )
+        self.after_eval_hook.append(self._report_counters)
+
+    # --------------------------------------------------------------- the env
+    def _make_gym_env(self):
+        if self._gym_env is not None:
+            return self._gym_env
+        import gymnasium as gym
+
+        if callable(self._env_spec):
+            self._gym_env = self._env_spec(**self._env_config)
+        else:
+            name = str(self._env_spec)
+            if name.startswith("gym::"):
+                name = name[len("gym::") :]
+            self._gym_env = gym.make(name, **self._env_config)
+        return self._gym_env
+
+    @property
+    def _env(self):
+        return self._make_gym_env()
+
+    def _network_constants(self) -> dict:
+        env = self._make_gym_env()
+        obs_space = env.observation_space
+        act_space = env.action_space
+        obs_length = int(np.prod(obs_space.shape))
+        if hasattr(act_space, "n"):
+            act_length = int(act_space.n)
+        else:
+            act_length = int(np.prod(act_space.shape))
+        return {
+            "obs_length": obs_length,
+            "act_length": act_length,
+            "obs_space": obs_space,
+            "act_space": act_space,
+            "obs_shape": tuple(obs_space.shape),
+        }
+
+    @property
+    def observation_normalization(self) -> bool:
+        return self._observation_normalization
+
+    def _report_counters(self, batch) -> dict:
+        return {
+            "total_interaction_count": self._interaction_count,
+            "total_episode_count": self._episode_count,
+        }
+
+    # ------------------------------------------------------------- rollouts
+    def _normalize_observation(self, obs, *, update_stats: bool = True) -> np.ndarray:
+        obs = np.asarray(obs, dtype=np.float32).reshape(-1)
+        if self._observation_normalization:
+            if update_stats:
+                self._obs_stats.update(obs)
+            return np.asarray(self._obs_stats.normalize(obs), dtype=np.float32)
+        return obs
+
+    def _action_from_output(self, output: np.ndarray):
+        env = self._make_gym_env()
+        act_space = env.action_space
+        if hasattr(act_space, "n"):
+            return int(np.argmax(output))
+        action = np.asarray(output, dtype=np.float64).reshape(act_space.shape)
+        if self._action_noise_stdev is not None:
+            action = action + np.random.randn(*action.shape) * self._action_noise_stdev
+        return np.clip(action, act_space.low, act_space.high)
+
+    def _rollout(
+        self,
+        policy_apply: Callable,
+        *,
+        update_stats: bool = True,
+        visualize: bool = False,
+        decrease_rewards_by: Optional[float] = None,
+    ) -> dict:
+        """One episode (reference ``gymne.py:361-414``)."""
+        env = self._make_gym_env()
+        decrease = self._decrease_rewards_by if decrease_rewards_by is None else float(decrease_rewards_by)
+        obs = self._normalize_observation(reset_env(env), update_stats=update_stats)
+        state = None
+        cumulative = 0.0
+        t = 0
+        while True:
+            out, state = policy_apply(jnp.asarray(obs), state)
+            action = self._action_from_output(np.asarray(out))
+            raw_obs, reward, done = take_step_in_env(env, action)
+            t += 1
+            self._interaction_count += 1
+            reward = reward - decrease
+            if self._alive_bonus_schedule is not None and not done:
+                reward += float(alive_bonus_for_step(t, self._alive_bonus_schedule))
+            cumulative += reward
+            if visualize and hasattr(env, "render"):
+                env.render()
+            obs = self._normalize_observation(raw_obs, update_stats=update_stats)
+            if done or (self._episode_length is not None and t >= int(self._episode_length)):
+                break
+        self._episode_count += 1
+        return {"cumulative_reward": cumulative, "interaction_count": t}
+
+    def _evaluate_network(self, flat_params):
+        apply = self.parameterize_net(flat_params)
+        total = 0.0
+        for _ in range(self._num_episodes):
+            total += self._rollout(apply)["cumulative_reward"]
+        return jnp.asarray(total / self._num_episodes)
+
+    def run_solution(self, solution, *, num_episodes: int = 1, visualize: bool = False) -> float:
+        """Deterministically run a solution (no stat updates)."""
+        values = solution.values if hasattr(solution, "values") else solution
+        apply = self.parameterize_net(jnp.asarray(values))
+        total = 0.0
+        for _ in range(int(num_episodes)):
+            total += self._rollout(apply, update_stats=False, visualize=visualize, decrease_rewards_by=0.0)[
+                "cumulative_reward"
+            ]
+        return total / num_episodes
+
+    def visualize(self, solution, *, num_episodes: int = 1) -> float:
+        """Render a solution's episodes (reference ``gymne.py:477``)."""
+        return self.run_solution(solution, num_episodes=num_episodes, visualize=True)
+
+    # ------------------------------------------------------- policy exports
+    def to_policy(self, solution) -> Module:
+        """Deployable module: obs-norm + network + action clip
+        (reference ``gymne.py:646-672``)."""
+        from .net.rl import ActClipLayer, ObsNormLayer
+
+        module = self._net_module
+        if self._observation_normalization and self._obs_stats.count >= 2:
+            module = (
+                ObsNormLayer(mean=self._obs_stats.mean, stdev=self._obs_stats.stdev)
+                >> module
+            )
+        env = self._make_gym_env()
+        act_space = env.action_space
+        if not hasattr(act_space, "n"):
+            module = module >> ActClipLayer(act_space.low, act_space.high)
+        return module
+
+    def get_observation_stats(self) -> RunningStat:
+        return self._obs_stats
+
+    def set_observation_stats(self, stats: RunningStat):
+        self._obs_stats = stats
+
+    def save_solution(self, solution, fname: str):
+        """Pickle the solution values + obs stats + network spec
+        (reference ``gymne.py:674-724``)."""
+        values = np.asarray(solution.values if hasattr(solution, "values") else solution)
+        payload = {
+            "values": values,
+            "obs_count": self._obs_stats.count,
+            "obs_mean": None if self._obs_stats.count < 2 else self._obs_stats.mean,
+            "obs_stdev": None if self._obs_stats.count < 2 else self._obs_stats.stdev,
+            "network_spec": self._network_spec if isinstance(self._network_spec, str) else repr(self._network_spec),
+            "env_spec": self._env_spec if isinstance(self._env_spec, str) else repr(self._env_spec),
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def _get_cloned_state(self, *, memo: dict) -> dict:
+        state = super()._get_cloned_state(memo=memo)
+        state["_gym_env"] = None  # env handles are not picklable
+        return state

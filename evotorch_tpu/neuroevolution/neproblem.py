@@ -1,0 +1,140 @@
+"""Generic neuroevolution problem: solutions are flat network parameters.
+
+Parity: reference ``neuroevolution/neproblem.py:33-429`` (``NEProblem``) and
+``baseneproblem.py:18-27`` (``BaseNEProblem`` marker). The solution length is
+the network's parameter count (``neproblem.py:235``); the network spec may be
+a string (-> ``str_to_net``), a layer ``Module``, or a callable returning one
+(``_instantiate_net``, ``neproblem.py:292-315``), optionally decorated with
+``@pass_info`` to receive problem info kwargs.
+
+TPU-first: instead of ``parameterize_net`` filling a cached torch module
+(``neproblem.py:342-363``), evaluation is pure — the flat vector is unraveled
+inside jit, and when the user's ``network_eval_func`` is jax-pure the whole
+population is evaluated in one vmapped program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import Problem, SolutionBatch
+from .net.functional import FlatParamsPolicy
+from .net.layers import Module
+from .net.parser import str_to_net
+
+__all__ = ["BaseNEProblem", "NEProblem"]
+
+
+class BaseNEProblem(Problem):
+    """Marker base (reference ``baseneproblem.py:18``)."""
+
+
+class NEProblem(BaseNEProblem):
+    def __init__(
+        self,
+        objective_sense,
+        network: Union[str, Module, Callable],
+        network_eval_func: Optional[Callable] = None,
+        *,
+        network_args: Optional[dict] = None,
+        initial_bounds=(-0.00001, 0.00001),
+        eval_dtype=None,
+        eval_data_length: int = 0,
+        seed: Optional[int] = None,
+        num_actors=None,
+        vectorized_network_eval: bool = True,
+        **kwargs,
+    ):
+        self._network_spec = network
+        self._network_args = dict(network_args or {})
+        self._network_eval_func = network_eval_func
+        self._vectorized_network_eval = bool(vectorized_network_eval)
+
+        net = self._instantiate_net(network)
+        self._net_module = net
+        self._policy = FlatParamsPolicy(net)
+
+        super().__init__(
+            objective_sense,
+            initial_bounds=initial_bounds,
+            solution_length=self._policy.parameter_count,
+            eval_dtype=eval_dtype,
+            eval_data_length=eval_data_length,
+            seed=seed,
+            num_actors=num_actors,
+            **kwargs,
+        )
+
+    # ------------------------------------------------------------ networking
+    def _network_constants(self) -> dict:
+        """Constants injected into ``str_to_net`` strings and ``@pass_info``
+        callables (reference ``neproblem.py:262-290``). Subclasses (GymNE
+        etc.) extend this with ``obs_length``/``act_length``/..."""
+        return {}
+
+    def _instantiate_net(self, network) -> Module:
+        constants = self._network_constants()
+        if isinstance(network, str):
+            return str_to_net(network, **{**constants, **self._network_args})
+        if isinstance(network, Module):
+            return network
+        if callable(network):
+            if getattr(network, "__evotorch_pass_info__", False):
+                return network(**{**constants, **self._network_args})
+            return network(**self._network_args) if self._network_args else network()
+        raise TypeError(f"Cannot interpret network specification of type {type(network)}")
+
+    @property
+    def network_module(self) -> Module:
+        return self._net_module
+
+    @property
+    def policy(self) -> FlatParamsPolicy:
+        return self._policy
+
+    def make_net(self, solution) -> tuple:
+        """Structured parameters for one solution (the analog of the
+        reference's instantiated-net copy, ``neproblem.py:323``): returns
+        ``(module, params_pytree)``."""
+        values = solution.values if hasattr(solution, "values") else solution
+        return self._net_module, self._policy.unravel(jnp.asarray(values))
+
+    def parameterize_net(self, values) -> Callable:
+        """A ready-to-call ``f(x[, state]) -> y[, state]`` closure over one
+        flat parameter vector (reference ``neproblem.py:342-363``)."""
+        flat = jnp.asarray(values)
+
+        def apply(x, state=None):
+            return self._policy(flat, x, state)
+
+        return apply
+
+    # ------------------------------------------------------------ generation
+    def _fill(self, num_solutions: int, key):
+        """Initialize solutions near zero (the reference's tiny
+        initial_bounds default) unless custom bounds were given."""
+        return super()._fill(num_solutions, key)
+
+    # ------------------------------------------------------------ evaluation
+    def _evaluate_network(self, flat_params: jnp.ndarray):
+        """Fitness of one network, given its flat parameters. Override this,
+        or provide ``network_eval_func`` (reference ``neproblem.py:407-429``).
+        Must be jax-pure when ``vectorized_network_eval`` (the default)."""
+        if self._network_eval_func is None:
+            raise NotImplementedError(
+                "Provide network_eval_func or override _evaluate_network"
+            )
+        return self._network_eval_func(self._policy, flat_params)
+
+    def _evaluate_batch(self, batch: SolutionBatch):
+        values = jnp.asarray(batch.values)
+        if self._vectorized_network_eval:
+            results = jax.vmap(self._evaluate_network)(values)
+            batch.set_evals(*self._split_eval_outputs(results))
+        else:
+            for sln in batch:
+                result = self._evaluate_network(jnp.asarray(sln.values))
+                sln.set_evals(result)

@@ -1,0 +1,22 @@
+"""Network misc helpers (reference ``net/misc.py:26-116``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .functional import count_parameters, fill_parameters, parameter_vector
+
+__all__ = ["count_parameters", "fill_parameters", "parameter_vector", "device_of_module"]
+
+
+def device_of_module(params) -> str:
+    """Device of a parameter pytree (reference ``net/misc.py:104``); in JAX
+    this is informational only — placement is controlled by shardings."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(params)
+    for leaf in leaves:
+        if hasattr(leaf, "devices"):
+            devices = leaf.devices()
+            return str(next(iter(devices)))
+    return "cpu"

@@ -1,0 +1,27 @@
+"""Stateful-module shims.
+
+Parity: reference ``net/statefulmodule.py:21-107`` (``StatefulModule`` /
+``ensure_stateful`` hide the recurrent h in/out pair) and
+``net/multilayered.py:21-74`` (sequential container threading hidden state).
+
+In this framework every layer already follows the explicit
+``apply(params, x, state) -> (y, state)`` protocol and ``Sequential`` threads
+states natively, so these are thin aliases kept for API familiarity.
+"""
+
+from __future__ import annotations
+
+from .layers import Module, Sequential
+
+__all__ = ["StatefulModule", "ensure_stateful", "MultiLayered"]
+
+StatefulModule = Module
+MultiLayered = Sequential
+
+
+def ensure_stateful(module: Module) -> Module:
+    """All modules are stateful-protocol already; returns the module
+    (reference ``statefulmodule.py:95-107``)."""
+    if not isinstance(module, Module):
+        raise TypeError(f"Expected a Module, got {type(module)}")
+    return module

@@ -1,0 +1,300 @@
+"""Vectorized-RL plumbing: batched policies and the jitted rollout engine.
+
+Parity: reference ``net/vecrl.py`` (1912 LoC). What the reference assembles
+from dlpack converters (``vecrl.py:53-82``), ``TorchWrapper``
+(``vecrl.py:362-613``), a stateful ``Policy`` with auto-vmap forward and
+per-env reset (``vecrl.py:1019-1361``), ``reset_tensors``
+(``vecrl.py:866-1016``) and eager Python stepping (``vecgymne.py:837-904``)
+becomes here ONE jitted program: ``run_vectorized_rollout`` compiles the
+entire population x envs x time loop — masked activity, auto-reset,
+episode/interaction accounting, obs-norm statistics in the carry — into a
+single ``lax.while_loop`` (SURVEY.md §3.4 and §5 long-context note).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...tools.pytree import pytree_dataclass, replace
+from ..net.functional import FlatParamsPolicy
+from ..net.rl import alive_bonus_for_step
+from ..net.runningnorm import CollectedStats, stats_normalize, stats_update
+
+__all__ = ["Policy", "reset_tensors", "run_vectorized_rollout", "RolloutResult"]
+
+
+def reset_tensors(tree: Any, mask: jnp.ndarray) -> Any:
+    """Zero the rows of every leaf where ``mask`` is True (the reference's
+    nested-state resetter, ``vecrl.py:866-1016``), as a pure function."""
+
+    def zero_rows(leaf):
+        m = mask.reshape(mask.shape + (1,) * (leaf.ndim - mask.ndim))
+        return jnp.where(m, jnp.zeros_like(leaf), leaf)
+
+    return jax.tree_util.tree_map(zero_rows, tree)
+
+
+class Policy:
+    """Stateful convenience wrapper over a flat-params policy
+    (reference ``Policy``, ``vecrl.py:1019-1361``): give it parameters for one
+    solution or a batch of solutions, call it on observations, and it manages
+    the recurrent state — including per-env ``reset(indices)``."""
+
+    def __init__(self, net, *, key=None):
+        from .functional import FlatParamsPolicy
+        from .layers import Module
+
+        if isinstance(net, FlatParamsPolicy):
+            self._flat = net
+        elif isinstance(net, Module):
+            self._flat = FlatParamsPolicy(net, key=key)
+        else:
+            raise TypeError(f"Policy expects a Module or FlatParamsPolicy, got {type(net)}")
+        self._params: Optional[jnp.ndarray] = None
+        self._state = None
+        self._batched = False
+
+    @property
+    def parameter_count(self) -> int:
+        return self._flat.parameter_count
+
+    def set_parameters(self, parameters, *, reset: bool = True):
+        """Accepts ``(L,)`` for one policy or ``(N, L)`` for a batch of
+        policies (reference ``vecrl.py:1191``)."""
+        parameters = jnp.asarray(parameters)
+        self._params = parameters
+        self._batched = parameters.ndim == 2
+        if reset:
+            self._state = None
+
+    def _fresh_state(self, batch_size: Optional[int]):
+        proto = self._flat.initial_state()
+        if proto is None:
+            return None
+        if batch_size is None:
+            return proto
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(leaf, (batch_size,) + leaf.shape), proto
+        )
+
+    def __call__(self, obs) -> jnp.ndarray:
+        if self._params is None:
+            raise RuntimeError("Call set_parameters(...) before using the Policy")
+        obs = jnp.asarray(obs)
+        if self._batched:
+            n = self._params.shape[0]
+            if self._state is None:
+                self._state = self._fresh_state(n)
+            if self._state is None:
+                out, _ = jax.vmap(lambda p, o: self._flat(p, o))(self._params, obs)
+                return out
+            out, self._state = jax.vmap(lambda p, o, s: self._flat(p, o, s))(
+                self._params, obs, self._state
+            )
+            return out
+        if self._state is None:
+            self._state = self._fresh_state(None)
+        out, self._state = self._flat(self._params, obs, self._state)
+        return out
+
+    def reset(self, indices=None):
+        """Reset recurrent state — fully, or only the rows given by a boolean
+        mask / index array (reference ``vecrl.py:1281``)."""
+        if self._state is None or indices is None:
+            self._state = None
+            return
+        mask = jnp.asarray(indices)
+        if mask.dtype != jnp.bool_:
+            n = self._params.shape[0]
+            mask = jnp.zeros(n, dtype=bool).at[mask].set(True)
+        self._state = reset_tensors(self._state, mask)
+
+    @property
+    def h(self):
+        return self._state
+
+
+class RolloutResult(NamedTuple):
+    scores: jnp.ndarray  # (N,) mean episodic return per solution
+    stats: CollectedStats  # obs-norm statistics collected during the rollout
+    total_steps: jnp.ndarray  # scalar: total env interactions
+    total_episodes: jnp.ndarray  # scalar: episodes finished
+
+
+def _policy_to_action(raw, action_space, noise, clip: bool):
+    if action_space.is_discrete:
+        return jnp.argmax(raw, axis=-1)
+    act = raw if noise is None else raw + noise
+    if clip and action_space.lb is not None:
+        act = jnp.clip(act, action_space.lb, action_space.ub)
+    return act
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "env",
+        "policy",
+        "num_episodes",
+        "episode_length",
+        "observation_normalization",
+        "alive_bonus_schedule",
+        "decrease_rewards_by",
+        "action_noise_stdev",
+    ),
+)
+def run_vectorized_rollout(
+    env,
+    policy: FlatParamsPolicy,
+    params_batch: jnp.ndarray,
+    key,
+    stats: CollectedStats,
+    *,
+    num_episodes: int = 1,
+    episode_length: Optional[int] = None,
+    observation_normalization: bool = False,
+    alive_bonus_schedule: Optional[tuple] = None,
+    decrease_rewards_by: Optional[float] = None,
+    action_noise_stdev: Optional[float] = None,
+) -> RolloutResult:
+    """Evaluate ``N`` policies on ``N`` environments, fully on-device.
+
+    The logic mirrors ``VecGymNE._evaluate_subbatch``
+    (``vecgymne.py:744-916``): one sub-environment per solution, lockstep
+    stepping with an activity mask, auto-reset until each env has finished
+    ``num_episodes`` episodes, masked running-norm updates, alive-bonus and
+    reward adjustments — but compiled into a single ``lax.while_loop``.
+    """
+    n = params_batch.shape[0]
+    max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
+    if episode_length is not None:
+        max_t = min(max_t, int(episode_length))
+    hard_cap = max_t * int(num_episodes) + 1
+
+    key, sub = jax.random.split(key)
+    reset_keys = jax.random.split(sub, n)
+    env_states, obs = jax.vmap(env.reset)(reset_keys)
+
+    policy_proto = policy.initial_state()
+    if policy_proto is None:
+        policy_states = None
+    else:
+        policy_states = jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(leaf, (n,) + leaf.shape), policy_proto
+        )
+
+    class Carry(NamedTuple):
+        env_states: Any
+        obs: jnp.ndarray
+        policy_states: Any
+        scores: jnp.ndarray
+        episodes_done: jnp.ndarray
+        steps_in_episode: jnp.ndarray
+        active: jnp.ndarray
+        stats: CollectedStats
+        key: Any
+        total_steps: jnp.ndarray
+        t_global: jnp.ndarray
+
+    carry = Carry(
+        env_states=env_states,
+        obs=obs,
+        policy_states=policy_states,
+        scores=jnp.zeros(n),
+        episodes_done=jnp.zeros(n, dtype=jnp.int32),
+        steps_in_episode=jnp.zeros(n, dtype=jnp.int32),
+        active=jnp.ones(n, dtype=bool),
+        stats=stats,
+        key=key,
+        total_steps=jnp.zeros((), dtype=jnp.int32),
+        t_global=jnp.zeros((), dtype=jnp.int32),
+    )
+
+    def cond(c: Carry):
+        return jnp.any(c.active) & (c.t_global < hard_cap)
+
+    def body(c: Carry) -> Carry:
+        key, noise_key, reset_key = jax.random.split(c.key, 3)
+
+        policy_in = (
+            stats_normalize(c.stats, c.obs) if observation_normalization else c.obs
+        )
+        if c.policy_states is None:
+            raw, new_policy_states = jax.vmap(lambda p, o: policy(p, o))(
+                params_batch, policy_in
+            )
+        else:
+            raw, new_policy_states = jax.vmap(policy)(params_batch, policy_in, c.policy_states)
+
+        noise = None
+        if action_noise_stdev is not None:
+            noise = action_noise_stdev * jax.random.normal(noise_key, raw.shape)
+        actions = _policy_to_action(raw, env.action_space, noise, clip=True)
+
+        new_env_states, new_obs, rewards, dones = jax.vmap(env.step)(c.env_states, actions)
+
+        steps_in_episode = c.steps_in_episode + 1
+        if episode_length is not None:
+            forced = steps_in_episode >= int(episode_length)
+            dones = dones | forced
+
+        if decrease_rewards_by is not None:
+            rewards = rewards - decrease_rewards_by
+        if alive_bonus_schedule is not None:
+            rewards = rewards + alive_bonus_for_step(
+                steps_in_episode, alive_bonus_schedule
+            ) * (~dones)
+
+        active_f = c.active
+        scores = c.scores + jnp.where(active_f, rewards, 0.0)
+        new_stats = (
+            stats_update(c.stats, new_obs, mask=active_f)
+            if observation_normalization
+            else c.stats
+        )
+
+        # auto-reset the envs that finished an episode (only matters while active)
+        finished = dones & active_f
+        episodes_done = c.episodes_done + finished.astype(jnp.int32)
+        reset_keys = jax.random.split(reset_key, n)
+        fresh_states, fresh_obs = jax.vmap(env.reset)(reset_keys)
+
+        def select(new, fresh):
+            m = finished.reshape(finished.shape + (1,) * (new.ndim - 1))
+            return jnp.where(m, fresh, new)
+
+        env_states_next = jax.tree_util.tree_map(select, new_env_states, fresh_states)
+        obs_next = select(new_obs, fresh_obs)
+        steps_in_episode = jnp.where(finished, 0, steps_in_episode)
+        if new_policy_states is not None:
+            new_policy_states = reset_tensors(new_policy_states, finished)
+
+        active = episodes_done < num_episodes
+        total_steps = c.total_steps + jnp.sum(active_f.astype(jnp.int32))
+
+        return Carry(
+            env_states=env_states_next,
+            obs=obs_next,
+            policy_states=new_policy_states,
+            scores=scores,
+            episodes_done=episodes_done,
+            steps_in_episode=steps_in_episode,
+            active=active,
+            stats=new_stats,
+            key=key,
+            total_steps=total_steps,
+            t_global=c.t_global + 1,
+        )
+
+    final = jax.lax.while_loop(cond, body, carry)
+    mean_scores = final.scores / jnp.maximum(final.episodes_done, 1)
+    return RolloutResult(
+        scores=mean_scores,
+        stats=final.stats,
+        total_steps=final.total_steps,
+        total_episodes=jnp.sum(final.episodes_done),
+    )

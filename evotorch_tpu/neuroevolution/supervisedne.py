@@ -1,0 +1,106 @@
+"""Supervised neuroevolution: minibatch loss as fitness.
+
+Parity: reference ``neuroevolution/supervisedne.py:30-348`` (``SupervisedNE``):
+the fitness of a network is its loss on the next minibatch; one common
+minibatch is shared by the whole population per evaluation round
+(``minibatch_size``, ``num_minibatches``).
+
+TPU-first: the dataset lives on device as arrays; the per-population
+evaluation is a single vmapped forward + loss, hitting the MXU with a
+``(popsize, batch, features)`` batched matmul instead of a per-network loop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import SolutionBatch
+from .neproblem import NEProblem
+
+__all__ = ["SupervisedNE", "mse_loss", "cross_entropy_loss"]
+
+
+def mse_loss(pred, target):
+    return jnp.mean((pred - target) ** 2)
+
+
+def cross_entropy_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if labels.ndim == logits.ndim:
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+
+class SupervisedNE(NEProblem):
+    def __init__(
+        self,
+        dataset: Union[Tuple, "object"],
+        network,
+        loss_func: Optional[Callable] = None,
+        *,
+        network_args: Optional[dict] = None,
+        initial_bounds=(-0.00001, 0.00001),
+        minibatch_size: Optional[int] = None,
+        num_minibatches: Optional[int] = None,
+        seed: Optional[int] = None,
+        num_actors=None,
+        common_minibatch: bool = True,
+        **kwargs,
+    ):
+        # dataset: (inputs, targets) arrays, or any object with such a pair
+        if isinstance(dataset, tuple) and len(dataset) == 2:
+            inputs, targets = dataset
+        else:
+            raise TypeError(
+                "dataset is expected as a pair (inputs, targets) of arrays "
+                "(torch DataLoaders have no TPU-resident equivalent; convert "
+                "your data to arrays first)"
+            )
+        self._inputs = jnp.asarray(inputs)
+        self._targets = jnp.asarray(targets)
+        if self._inputs.shape[0] != self._targets.shape[0]:
+            raise ValueError("inputs and targets must have the same leading length")
+        self._dataset_size = int(self._inputs.shape[0])
+        self._minibatch_size = (
+            int(minibatch_size) if minibatch_size is not None else min(64, self._dataset_size)
+        )
+        self._num_minibatches = int(num_minibatches) if num_minibatches is not None else 1
+        self._common_minibatch = bool(common_minibatch)
+        self._loss_func = loss_func if loss_func is not None else mse_loss
+
+        super().__init__(
+            "min",
+            network,
+            network_args=network_args,
+            initial_bounds=initial_bounds,
+            seed=seed,
+            num_actors=num_actors,
+            **kwargs,
+        )
+
+    @property
+    def minibatch_size(self) -> int:
+        return self._minibatch_size
+
+    def _sample_minibatch(self, key):
+        idx = jax.random.randint(key, (self._minibatch_size,), 0, self._dataset_size)
+        return self._inputs[idx], self._targets[idx]
+
+    def loss(self, pred, target):
+        return self._loss_func(pred, target)
+
+    def _evaluate_network_on(self, flat_params, x, y):
+        pred, _ = self._policy(flat_params, x)
+        return self._loss_func(pred, y)
+
+    def _evaluate_batch(self, batch: SolutionBatch):
+        values = jnp.asarray(batch.values)
+        total = None
+        for _ in range(self._num_minibatches):
+            x, y = self._sample_minibatch(self.next_rng_key())
+            losses = jax.vmap(lambda p: self._evaluate_network_on(p, x, y))(values)
+            total = losses if total is None else total + losses
+        batch.set_evals(total / self._num_minibatches)

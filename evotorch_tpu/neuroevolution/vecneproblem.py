@@ -1,0 +1,268 @@
+"""Fully-vectorized RL neuroevolution — the throughput path.
+
+Parity: reference ``neuroevolution/vecgymne.py:95-1073`` (``VecGymNE``): one
+sub-environment per solution, batched policies, masked episode accounting,
+GPU-aware observation normalization, env-registry strings, alive bonus,
+reward adjustment, ``to_policy``/``save_solution``.
+
+TPU-first: the environment is a pure-JAX env (``evotorch_tpu.envs``; Brax via
+the gated adapter), and the whole evaluate is ONE jitted program
+(``net/vecrl.py:run_vectorized_rollout``) — no dlpack ping-pong, no Python
+stepping. With ``use_sharded_evaluation()``-style meshes, the population axis
+shards across devices via ``shard_map`` (the rollout being pure makes that a
+one-liner; see ``evaluate_sharded``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import SolutionBatch
+from ..envs import Env, make_env
+from ..parallel.mesh import default_mesh
+from .neproblem import NEProblem
+from .net.layers import Module
+from .net.rl import ActClipLayer, ObsNormLayer
+from .net.runningnorm import CollectedStats, RunningNorm, stats_merge
+from .net.vecrl import run_vectorized_rollout
+
+__all__ = ["VecNE", "VecGymNE"]
+
+
+class VecNE(NEProblem):
+    """Vectorized neuroevolution over a pure-JAX env."""
+
+    def __init__(
+        self,
+        env: Union[str, Env],
+        network: Union[str, Module, Callable],
+        *,
+        env_config: Optional[dict] = None,
+        max_num_envs: Optional[int] = None,
+        network_args: Optional[dict] = None,
+        observation_normalization: bool = False,
+        decrease_rewards_by: Optional[float] = None,
+        alive_bonus_schedule: Optional[tuple] = None,
+        action_noise_stdev: Optional[float] = None,
+        num_episodes: int = 1,
+        episode_length: Optional[int] = None,
+        initial_bounds=(-0.00001, 0.00001),
+        seed: Optional[int] = None,
+        num_actors=None,
+        **kwargs,
+    ):
+        if isinstance(env, str):
+            self._env: Env = make_env(env, **(env_config or {}))
+        else:
+            self._env = env
+        self._observation_normalization = bool(observation_normalization)
+        self._decrease_rewards_by = decrease_rewards_by
+        self._alive_bonus_schedule = (
+            tuple(alive_bonus_schedule) if alive_bonus_schedule is not None else None
+        )
+        self._action_noise_stdev = action_noise_stdev
+        self._num_episodes = int(num_episodes)
+        self._episode_length = None if episode_length is None else int(episode_length)
+        self._max_num_envs = None if max_num_envs is None else int(max_num_envs)
+
+        self._obs_norm = RunningNorm(self._env.observation_size)
+        self._interaction_count = 0
+        self._episode_count = 0
+
+        super().__init__(
+            "max",
+            network,
+            network_args=network_args,
+            initial_bounds=initial_bounds,
+            seed=seed,
+            num_actors=num_actors,
+            **kwargs,
+        )
+        self.after_eval_hook.append(self._report_counters)
+
+    # ---------------------------------------------------------------- wiring
+    def _network_constants(self) -> dict:
+        env = self._env
+        return {
+            "obs_length": env.observation_size,
+            "act_length": env.action_size,
+            "obs_shape": tuple(env.observation_space.shape),
+            "obs_space": env.observation_space,
+            "act_space": env.action_space,
+        }
+
+    @property
+    def env(self) -> Env:
+        return self._env
+
+    @property
+    def observation_normalization(self) -> bool:
+        return self._observation_normalization
+
+    @property
+    def obs_norm(self) -> RunningNorm:
+        return self._obs_norm
+
+    def _report_counters(self, batch) -> dict:
+        return {
+            "total_interaction_count": self._interaction_count,
+            "total_episode_count": self._episode_count,
+        }
+
+    # ------------------------------------------------------------ evaluation
+    def _rollout_batch(self, values: jnp.ndarray, key) -> tuple:
+        result = run_vectorized_rollout(
+            self._env,
+            self._policy,
+            values,
+            key,
+            self._obs_norm.stats,
+            num_episodes=self._num_episodes,
+            episode_length=self._episode_length,
+            observation_normalization=self._observation_normalization,
+            alive_bonus_schedule=self._alive_bonus_schedule,
+            decrease_rewards_by=self._decrease_rewards_by,
+            action_noise_stdev=self._action_noise_stdev,
+        )
+        return result
+
+    def _evaluate_batch(self, batch: SolutionBatch):
+        values = jnp.asarray(batch.values)
+        n = values.shape[0]
+        if self._max_num_envs is not None and n > self._max_num_envs:
+            # workload splitting (reference vecgymne.py:440-455): evaluate in
+            # sub-batches of at most max_num_envs environments
+            scores = []
+            for start in range(0, n, self._max_num_envs):
+                result = self._rollout_batch(
+                    values[start : start + self._max_num_envs], self.next_rng_key()
+                )
+                scores.append(result.scores)
+                self._consume_rollout_side_effects(result)
+            batch.set_evals(jnp.concatenate(scores))
+            return
+        result = self._rollout_batch(values, self.next_rng_key())
+        self._consume_rollout_side_effects(result)
+        batch.set_evals(result.scores)
+
+    def _consume_rollout_side_effects(self, result):
+        if self._observation_normalization:
+            self._obs_norm.stats = result.stats
+        self._interaction_count += int(result.total_steps)
+        self._episode_count += int(result.total_episodes)
+
+    # ------------------------------------------------------- policy exports
+    def to_policy(self, solution) -> Module:
+        """Wrap a solution as a deployable policy module: obs-norm layer (if
+        any statistics were collected) + network + action clipping
+        (reference ``gymne.py:646-672`` / ``vecgymne.py:949-1010``)."""
+        module = self._net_module
+        if self._observation_normalization and self._obs_norm.count >= 2:
+            module = self._obs_norm.to_layer() >> module
+        space = self._env.action_space
+        if not space.is_discrete and space.lb is not None:
+            module = module >> ActClipLayer(space.lb, space.ub)
+        return module
+
+    def to_policy_callable(self, solution) -> Callable:
+        """A ready closure over the solution's parameters (includes obs-norm
+        and action clip)."""
+        values = jnp.asarray(solution.values if hasattr(solution, "values") else solution)
+
+        def apply(x, state=None):
+            y = x
+            if self._observation_normalization and self._obs_norm.count >= 2:
+                y = self._obs_norm.normalize(y)
+            out, new_state = self._policy(values, y, state)
+            space = self._env.action_space
+            if space.is_discrete:
+                out = jnp.argmax(out, axis=-1)
+            elif space.lb is not None:
+                out = jnp.clip(out, space.lb, space.ub)
+            return out, new_state
+
+        return apply
+
+    def save_solution(self, solution, fname: str):
+        """Pickle a solution with its policy and obs stats
+        (reference ``gymne.py:674-724``)."""
+        import pickle
+
+        values = np.asarray(solution.values if hasattr(solution, "values") else solution)
+        payload = {
+            "values": values,
+            "obs_mean": np.asarray(self._obs_norm.mean) if self._obs_norm.count >= 2 else None,
+            "obs_stdev": np.asarray(self._obs_norm.stdev) if self._obs_norm.count >= 2 else None,
+            "network_spec": self._network_spec if isinstance(self._network_spec, str) else repr(self._network_spec),
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    # ------------------------------------------------- sharded evaluation ---
+    def evaluate_sharded(self, batch: SolutionBatch, mesh=None, axis_name: str = "pop"):
+        """Evaluate with the population axis sharded over the mesh: each shard
+        rolls out its rows locally; obs-norm stats merge with a psum — the
+        collective form of the reference's actor delta-sync
+        (``gymne.py:524-573``, SURVEY.md §2.11)."""
+        from jax.sharding import PartitionSpec as P
+
+        if mesh is None:
+            mesh = default_mesh((axis_name,))
+        n_shards = mesh.shape[axis_name]
+        values = jnp.asarray(batch.values)
+        n = values.shape[0]
+        if n % n_shards != 0:
+            raise ValueError(f"Population size {n} must be divisible by mesh size {n_shards}")
+
+        stats = self._obs_norm.stats
+        obsnorm = self._observation_normalization
+
+        def local(values_shard, key, stats):
+            my_key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+            result = run_vectorized_rollout(
+                self._env,
+                self._policy,
+                values_shard,
+                my_key,
+                stats,
+                num_episodes=self._num_episodes,
+                episode_length=self._episode_length,
+                observation_normalization=obsnorm,
+                alive_bonus_schedule=self._alive_bonus_schedule,
+                decrease_rewards_by=self._decrease_rewards_by,
+                action_noise_stdev=self._action_noise_stdev,
+            )
+            # merge the per-shard stat deltas with a psum
+            delta = jax.tree_util.tree_map(lambda new, old: new - old, result.stats, stats)
+            merged = jax.tree_util.tree_map(
+                lambda old, d: old + jax.lax.psum(d, axis_name), stats, delta
+            )
+            return (
+                result.scores,
+                merged,
+                jax.lax.psum(result.total_steps, axis_name),
+                jax.lax.psum(result.total_episodes, axis_name),
+            )
+
+        sharded = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(), P()),
+            out_specs=(P(axis_name), P(), P(), P()),
+            check_vma=False,
+        )
+        scores, merged_stats, steps, episodes = sharded(values, self.next_rng_key(), stats)
+        if obsnorm:
+            self._obs_norm.stats = jax.tree_util.tree_map(lambda x: x, merged_stats)
+        self._interaction_count += int(steps)
+        self._episode_count += int(episodes)
+        batch.set_evals(scores)
+        self._status.update(self._report_counters(batch))
+
+
+# the reference's class name, for drop-in familiarity
+VecGymNE = VecNE

@@ -330,3 +330,16 @@ def test_sample_and_compute_gradients_adaptive():
     )
     # 10 solutions -> 100 interactions per chunk; threshold 250 -> 3 chunks
     assert result["num_solutions"] == 30
+
+
+def test_getitem_with_zero_d_index():
+    # review regression: batch[batch.argbest()] must return a Solution
+    p = make_problem()
+    batch = p.generate_batch(5)
+    batch.set_evals(jnp.array([3.0, 1.0, 2.0, 5.0, 4.0]))
+    sln = batch[batch.argbest()]
+    assert isinstance(sln, Solution)
+    assert float(sln.evals[0]) == 1.0
+    # 1-d index arrays still produce sub-batches
+    sub = batch[jnp.array([0, 2])]
+    assert isinstance(sub, SolutionBatch) and len(sub) == 2

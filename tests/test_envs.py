@@ -1,0 +1,81 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.envs import Acrobot, CartPole, MountainCarContinuous, Pendulum, Swimmer2D, make_env
+
+
+@pytest.mark.parametrize(
+    "env_ctor",
+    [CartPole, Pendulum, Acrobot, MountainCarContinuous, Swimmer2D],
+)
+def test_env_protocol(env_ctor):
+    env = env_ctor()
+    state, obs = env.reset(jax.random.key(0))
+    assert obs.shape == env.observation_space.shape
+    if env.action_space.is_discrete:
+        action = jnp.zeros((), dtype=jnp.int32)
+    else:
+        action = jnp.zeros(env.action_space.shape)
+    state, obs, reward, done = env.step(state, action)
+    assert obs.shape == env.observation_space.shape
+    assert reward.shape == ()
+    assert done.shape == ()
+
+
+@pytest.mark.parametrize("env_ctor", [CartPole, Pendulum])
+def test_env_vmapped_and_jitted(env_ctor):
+    env = env_ctor()
+    n = 6
+    keys = jax.random.split(jax.random.key(0), n)
+    states, obs = jax.vmap(env.reset)(keys)
+    assert obs.shape == (n,) + env.observation_space.shape
+    if env.action_space.is_discrete:
+        actions = jnp.zeros(n, dtype=jnp.int32)
+    else:
+        actions = jnp.zeros((n,) + env.action_space.shape)
+
+    @jax.jit
+    def multi_step(states, actions):
+        return jax.vmap(env.step)(states, actions)
+
+    states, obs, rewards, dones = multi_step(states, actions)
+    assert rewards.shape == (n,)
+
+
+def test_cartpole_terminates_on_pole_fall():
+    env = CartPole()
+    state, obs = env.reset(jax.random.key(1))
+    done = jnp.zeros((), bool)
+    # always push right: the pole falls within the episode
+    for _ in range(200):
+        state, obs, reward, done = env.step(state, jnp.ones((), dtype=jnp.int32))
+        if bool(done):
+            break
+    assert bool(done)
+
+
+def test_pendulum_reward_negative_cost():
+    env = Pendulum()
+    state, obs = env.reset(jax.random.key(0))
+    _, _, reward, _ = env.step(state, jnp.zeros(1))
+    assert float(reward) <= 0.0
+
+
+def test_registry():
+    env = make_env("cartpole")
+    assert isinstance(env, CartPole)
+    assert isinstance(make_env("CartPole-v1"), CartPole)
+    assert isinstance(make_env("pendulum"), Pendulum)
+    with pytest.raises(ValueError):
+        make_env("nonexistent_env")
+    with pytest.raises(ImportError):
+        make_env("brax::humanoid")
+
+
+def test_env_determinism():
+    env = Pendulum()
+    s1, o1 = env.reset(jax.random.key(5))
+    s2, o2 = env.reset(jax.random.key(5))
+    assert np.allclose(np.asarray(o1), np.asarray(o2))

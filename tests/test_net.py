@@ -1,0 +1,143 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.neuroevolution.net import (
+    LSTM,
+    RNN,
+    Clip,
+    FeedForwardNet,
+    FlatParamsPolicy,
+    Linear,
+    LocomotorNet,
+    NetParsingError,
+    Sequential,
+    StructuredControlNet,
+    Tanh,
+    count_parameters,
+    fill_parameters,
+    parameter_vector,
+    str_to_net,
+)
+
+
+def test_linear_layer():
+    layer = Linear(3, 2)
+    params = layer.init(jax.random.key(0))
+    assert params["weight"].shape == (2, 3)
+    assert params["bias"].shape == (2,)
+    y, _ = layer.apply(params, jnp.ones(3))
+    assert y.shape == (2,)
+    # batched input works without modification
+    y, _ = layer.apply(params, jnp.ones((7, 3)))
+    assert y.shape == (7, 2)
+
+
+def test_sequential_composition():
+    net = Linear(4, 8) >> Tanh() >> Linear(8, 2)
+    assert isinstance(net, Sequential)
+    params = net.init(jax.random.key(0))
+    y, state = net.apply(params, jnp.ones(4))
+    assert y.shape == (2,)
+    assert state is None
+    assert float(jnp.max(jnp.abs(y))) < 10.0
+
+
+def test_rnn_state_threading():
+    net = RNN(3, 5)
+    params = net.init(jax.random.key(0))
+    x = jnp.ones(3)
+    y1, h1 = net.apply(params, x, None)
+    y2, h2 = net.apply(params, x, h1)
+    assert y1.shape == (5,)
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))
+    # state threads through Sequential around stateless layers
+    seq = Linear(3, 3) >> RNN(3, 4) >> Linear(4, 2)
+    p = seq.init(jax.random.key(1))
+    out, st = seq.apply(p, x)
+    assert out.shape == (2,)
+    assert st is not None
+    out2, st2 = seq.apply(p, x, st)
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_lstm_cell():
+    net = LSTM(2, 3)
+    params = net.init(jax.random.key(0))
+    y, (h, c) = net.apply(params, jnp.ones(2))
+    assert y.shape == (3,) and h.shape == (3,) and c.shape == (3,)
+    assert np.allclose(np.asarray(y), np.asarray(h))
+
+
+def test_flat_params_policy_and_vmap():
+    net = Linear(4, 3) >> Tanh()
+    policy = FlatParamsPolicy(net)
+    n = policy.parameter_count
+    assert n == 4 * 3 + 3
+    flat = policy.init_parameters(jax.random.key(1))
+    y, _ = policy(flat, jnp.ones(4))
+    assert y.shape == (3,)
+    # population-batched forward: vmap over params
+    pop = jnp.stack([flat, flat * 0.0])
+    ys, _ = jax.vmap(lambda p, x: policy(p, x))(pop, jnp.ones((2, 4)))
+    assert ys.shape == (2, 3)
+    assert np.allclose(np.asarray(ys[1]), 0.0)
+
+
+def test_parameter_vector_roundtrip():
+    net = Linear(3, 2)
+    params = net.init(jax.random.key(0))
+    vec = parameter_vector(params)
+    restored = fill_parameters(params, vec)
+    assert np.allclose(np.asarray(restored["weight"]), np.asarray(params["weight"]))
+    assert count_parameters(net) == vec.shape[0]
+
+
+def test_str_to_net():
+    net = str_to_net(
+        "Linear(obs_length, 16) >> Tanh() >> Linear(16, act_length)",
+        obs_length=4,
+        act_length=2,
+    )
+    params = net.init(jax.random.key(0))
+    y, _ = net.apply(params, jnp.ones(4))
+    assert y.shape == (2,)
+
+
+def test_str_to_net_arithmetic_and_kwargs():
+    net = str_to_net("Linear(n, n * 2, bias=False) >> Clip(-1.0, 1.0)", n=3)
+    params = net.init(jax.random.key(0))
+    y, _ = net.apply(params, jnp.full((3,), 100.0))
+    assert y.shape == (6,)
+    assert float(jnp.max(y)) <= 1.0
+
+
+def test_str_to_net_errors():
+    with pytest.raises(NetParsingError):
+        str_to_net("NotALayer(3, 4)")
+    with pytest.raises(NetParsingError):
+        str_to_net("Linear(3, unknown_name)")
+    with pytest.raises(NetParsingError):
+        str_to_net("__import__('os')")
+    with pytest.raises(NetParsingError):
+        str_to_net("1 + 2")
+
+
+def test_structured_control_net_and_locomotor():
+    scn = StructuredControlNet(in_features=4, out_features=2, num_layers=2, hidden_size=8)
+    params = scn.init(jax.random.key(0))
+    y, _ = scn.apply(params, jnp.ones(4))
+    assert y.shape == (2,)
+
+    loco = LocomotorNet(in_features=4, out_features=2, num_sinusoids=4)
+    params = loco.init(jax.random.key(0))
+    y, _ = loco.apply(params, jnp.ones(4))
+    assert y.shape == (2,)
+
+
+def test_feed_forward_net():
+    net = FeedForwardNet(4, [(8, jnp.tanh), (2, None)])
+    params = net.init(jax.random.key(0))
+    y, _ = net.apply(params, jnp.ones(4))
+    assert y.shape == (2,)

@@ -1,0 +1,243 @@
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu import pass_info
+from evotorch_tpu.algorithms import PGPE, SNES
+from evotorch_tpu.neuroevolution import GymNE, NEProblem, SupervisedNE, VecGymNE, VecNE
+from evotorch_tpu.neuroevolution.net import Linear, Tanh
+
+
+# ---------------------------------------------------------------- NEProblem --
+
+
+def test_neproblem_solution_length_and_eval():
+    def eval_func(policy, flat_params):
+        # fitness: negative L2 norm of network output on a fixed input
+        y, _ = policy(flat_params, jnp.ones(4))
+        return -jnp.sum(y**2)
+
+    p = NEProblem("max", "Linear(4, 2)", eval_func)
+    assert p.solution_length == 4 * 2 + 2
+    batch = p.generate_batch(6)
+    p.evaluate(batch)
+    assert batch.is_evaluated
+
+
+def test_neproblem_network_forms():
+    # Module instance
+    p1 = NEProblem("max", Linear(3, 1), lambda pol, f: jnp.zeros(()))
+    assert p1.solution_length == 4
+
+    # plain callable
+    p2 = NEProblem("max", lambda: Linear(3, 1) >> Tanh(), lambda pol, f: jnp.zeros(()))
+    assert p2.solution_length == 4
+
+    # @pass_info callable receives constants (none for plain NEProblem)
+    @pass_info
+    def factory(**kwargs):
+        return Linear(2, 1)
+
+    p3 = NEProblem("max", factory, lambda pol, f: jnp.zeros(()))
+    assert p3.solution_length == 3
+
+
+def test_neproblem_parameterize_net():
+    p = NEProblem("max", "Linear(2, 2, bias=False)", lambda pol, f: jnp.zeros(()))
+    apply = p.parameterize_net(jnp.asarray([1.0, 0.0, 0.0, 1.0]))
+    y, _ = apply(jnp.asarray([3.0, 7.0]))
+    assert np.allclose(np.asarray(y), [3.0, 7.0])
+
+
+# -------------------------------------------------------------- SupervisedNE --
+
+
+def test_supervised_ne_learns_linear_map():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(256, 3)).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [0.5]], dtype=np.float32)
+    y = X @ w_true
+
+    problem = SupervisedNE(
+        (X, y),
+        "Linear(3, 1)",
+        minibatch_size=64,
+        seed=1,
+    )
+    searcher = SNES(problem, stdev_init=0.3, popsize=30)
+    searcher.run(40)
+    assert searcher.status["best_eval"] < 0.5
+
+    # evals are losses on a shared minibatch
+    batch = problem.generate_batch(4)
+    problem.evaluate(batch)
+    assert batch.evals.shape == (4, 1)
+
+
+# --------------------------------------------------------------------- VecNE --
+
+
+def test_vecne_cartpole_evaluation():
+    problem = VecNE(
+        "cartpole",
+        "Linear(obs_length, act_length)",
+        env_config={"continuous_actions": False},
+        seed=0,
+    )
+    assert problem.solution_length == 4 * 2 + 2
+    batch = problem.generate_batch(8)
+    problem.evaluate(batch)
+    scores = np.asarray(batch.evals[:, 0])
+    assert scores.shape == (8,)
+    assert (scores >= 1.0).all() and (scores <= 500.0).all()
+    status = problem.status
+    assert status["total_interaction_count"] > 0
+    assert status["total_episode_count"] == 8
+
+
+def test_vecne_pgpe_improves_cartpole():
+    problem = VecNE(
+        "cartpole",
+        "Linear(obs_length, act_length)",
+        env_config={"continuous_actions": False},
+        seed=2,
+    )
+    searcher = PGPE(
+        problem,
+        popsize=32,
+        center_learning_rate=0.4,
+        stdev_learning_rate=0.1,
+        stdev_init=0.5,
+    )
+    searcher.step()
+    first = searcher.status["mean_eval"]
+    searcher.run(12)
+    assert searcher.status["mean_eval"] > first
+
+
+def test_vecne_observation_normalization_and_episode_budget():
+    problem = VecNE(
+        "pendulum",
+        "Linear(obs_length, 8) >> Tanh() >> Linear(8, act_length)",
+        observation_normalization=True,
+        episode_length=30,
+        num_episodes=2,
+        seed=1,
+    )
+    batch = problem.generate_batch(4)
+    problem.evaluate(batch)
+    assert problem.obs_norm.count > 0
+    assert problem.status["total_episode_count"] == 8
+    assert problem.status["total_interaction_count"] == 4 * 30 * 2
+
+
+def test_vecne_max_num_envs_subbatching():
+    problem = VecNE(
+        "pendulum",
+        "Linear(obs_length, act_length)",
+        episode_length=10,
+        max_num_envs=3,
+        seed=1,
+    )
+    batch = problem.generate_batch(8)
+    problem.evaluate(batch)
+    assert batch.is_evaluated
+
+
+def test_vecne_to_policy_and_save(tmp_path):
+    problem = VecNE(
+        "pendulum",
+        "Linear(obs_length, act_length)",
+        observation_normalization=True,
+        episode_length=20,
+        seed=3,
+    )
+    batch = problem.generate_batch(4)
+    problem.evaluate(batch)
+    best = batch[int(np.asarray(batch.argbest()))]
+
+    apply = problem.to_policy_callable(best)
+    act, _ = apply(jnp.zeros(3))
+    assert act.shape == (1,)
+    assert -2.0 <= float(act[0]) <= 2.0
+
+    module = problem.to_policy(best)
+    fname = os.path.join(tmp_path, "sol.pkl")
+    problem.save_solution(best, fname)
+    with open(fname, "rb") as f:
+        payload = pickle.load(f)
+    assert payload["values"].shape == (problem.solution_length,)
+    assert payload["obs_mean"] is not None
+
+
+def test_vecne_sharded_evaluation():
+    problem = VecNE(
+        "pendulum",
+        "Linear(obs_length, act_length)",
+        observation_normalization=True,
+        episode_length=15,
+        seed=4,
+    )
+    batch = problem.generate_batch(16)
+    problem.evaluate_sharded(batch)
+    assert batch.is_evaluated
+    # stats merged across shards: 16 envs x 15 steps
+    assert problem.obs_norm.count == 16 * 15
+    assert problem.status["total_interaction_count"] == 240
+
+
+def test_vecgymne_alias():
+    assert VecGymNE is VecNE
+
+
+# --------------------------------------------------------------------- GymNE --
+
+
+def test_gymne_cartpole():
+    gym = pytest.importorskip("gymnasium")
+    problem = GymNE(
+        "CartPole-v1",
+        "Linear(obs_length, act_length)",
+        num_episodes=1,
+        episode_length=60,
+        seed=0,
+    )
+    assert problem.solution_length == 4 * 2 + 2
+    batch = problem.generate_batch(3)
+    problem.evaluate(batch)
+    scores = np.asarray(batch.evals[:, 0])
+    assert (scores >= 1.0).all()
+    assert problem.status["total_episode_count"] == 3
+
+    # deterministic re-run of a solution
+    score = problem.run_solution(batch[0], num_episodes=1)
+    assert score >= 1.0
+
+    # to_policy produces a module
+    module = problem.to_policy(batch[0])
+    params = module.init(jax.random.key(0))
+    y, _ = module.apply(params, jnp.zeros(4))
+    assert y.shape == (2,)
+
+
+def test_gymne_observation_normalization(tmp_path):
+    pytest.importorskip("gymnasium")
+    problem = GymNE(
+        "CartPole-v1",
+        "Linear(obs_length, act_length)",
+        observation_normalization=True,
+        episode_length=30,
+        seed=0,
+    )
+    batch = problem.generate_batch(2)
+    problem.evaluate(batch)
+    assert problem.get_observation_stats().count > 0
+    fname = os.path.join(tmp_path, "gym_sol.pkl")
+    problem.save_solution(batch[0], fname)
+    with open(fname, "rb") as f:
+        payload = pickle.load(f)
+    assert payload["obs_mean"] is not None

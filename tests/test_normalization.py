@@ -1,0 +1,101 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evotorch_tpu.neuroevolution.net.runningnorm import (
+    RunningNorm,
+    RunningStat,
+    stats_merge,
+    stats_normalize,
+    stats_update,
+)
+
+
+def test_running_norm_matches_numpy():
+    rn = RunningNorm(3)
+    data = np.random.randn(100, 3) * 2.0 + 5.0
+    rn.update(jnp.asarray(data))
+    assert np.allclose(np.asarray(rn.mean), data.mean(axis=0), atol=1e-4)
+    assert np.allclose(np.asarray(rn.stdev), data.std(axis=0, ddof=1), atol=1e-3)
+    normalized = np.asarray(rn.normalize(jnp.asarray(data)))
+    assert abs(normalized.mean()) < 0.01
+    assert abs(normalized.std() - 1.0) < 0.05
+
+
+def test_running_norm_masked_update():
+    rn = RunningNorm(2)
+    obs = jnp.array([[1.0, 1.0], [100.0, 100.0], [3.0, 3.0]])
+    mask = jnp.array([True, False, True])
+    rn.update(obs, mask)
+    assert rn.count == 2
+    assert np.allclose(np.asarray(rn.mean), [2.0, 2.0])
+
+
+def test_merge_equals_combined():
+    # merging two partial stats equals stats over the full data —
+    # the property that makes psum a valid distributed merge
+    data = np.random.randn(60, 4)
+    a = RunningNorm(4)
+    b = RunningNorm(4)
+    full = RunningNorm(4)
+    a.update(jnp.asarray(data[:25]))
+    b.update(jnp.asarray(data[25:]))
+    full.update(jnp.asarray(data))
+    a.update(b)
+    assert np.allclose(np.asarray(a.mean), np.asarray(full.mean), atol=1e-5)
+    assert np.allclose(np.asarray(a.stdev), np.asarray(full.stdev), atol=1e-5)
+
+
+def test_running_stat_equivalence():
+    # RunningStat (host) and RunningNorm (device) agree — the reference's
+    # test_normalization.py checks the same equivalence
+    data = np.random.randn(50, 3)
+    rs = RunningStat()
+    rn = RunningNorm(3)
+    rs.update(data)
+    rn.update(jnp.asarray(data))
+    assert np.allclose(rs.mean, np.asarray(rn.mean), atol=1e-4)
+    assert np.allclose(rs.stdev, np.asarray(rn.stdev), atol=1e-4)
+    # cross-merge: RunningNorm absorbs a RunningStat (the actor-delta path)
+    rn2 = RunningNorm(3)
+    rn2.update(rs)
+    assert np.allclose(np.asarray(rn2.mean), rs.mean, atol=1e-4)
+
+
+def test_running_stat_delta():
+    rs = RunningStat()
+    rs.update(np.ones((10, 2)))
+    snapshot = RunningStat()
+    snapshot.update(rs)
+    rs.update(np.zeros((10, 2)))
+    delta = rs.to_delta(snapshot)
+    assert delta.count == 10
+    assert np.allclose(delta.mean, 0.0)
+
+
+def test_normalize_identity_before_enough_data():
+    rn = RunningNorm(2)
+    x = jnp.array([5.0, -3.0])
+    assert np.allclose(np.asarray(rn.normalize(x)), np.asarray(x))
+
+
+def test_stats_update_inside_jit():
+    rn = RunningNorm(2)
+
+    @jax.jit
+    def roll(stats, xs):
+        def step(stats, x):
+            return stats_update(stats, x[None, :]), None
+
+        return jax.lax.scan(step, stats, xs)[0]
+
+    stats = roll(rn.stats, jnp.asarray(np.random.randn(20, 2)))
+    assert float(stats.count) == 20
+
+
+def test_to_layer():
+    rn = RunningNorm(2)
+    rn.update(jnp.asarray(np.random.randn(30, 2) * 3 + 1))
+    layer = rn.to_layer()
+    y, _ = layer.apply((), jnp.asarray([1.0, 1.0]))
+    assert y.shape == (2,)

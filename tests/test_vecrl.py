@@ -1,0 +1,160 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.envs import CartPole, Pendulum
+from evotorch_tpu.neuroevolution.net import (
+    LSTM,
+    RNN,
+    FlatParamsPolicy,
+    Linear,
+    Policy,
+    Tanh,
+    reset_tensors,
+    run_vectorized_rollout,
+)
+from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+
+
+# -- Policy wrapper (reference test_vecrl.py:142-274 analog) -----------------
+
+
+def test_policy_plain():
+    net = Linear(3, 2)
+    p = Policy(net)
+    flat = jnp.zeros(p.parameter_count)
+    p.set_parameters(flat)
+    out = p(jnp.ones(3))
+    assert out.shape == (2,)
+
+
+def test_policy_batched():
+    net = Linear(3, 2)
+    p = Policy(net)
+    flat = FlatParamsPolicy(net).init_parameters(jax.random.key(0))
+    p.set_parameters(jnp.stack([flat, flat * 0]))
+    out = p(jnp.ones((2, 3)))
+    assert out.shape == (2, 2)
+    assert np.allclose(np.asarray(out[1]), 0.0)
+
+
+def test_policy_recurrent():
+    net = RNN(3, 4)
+    p = Policy(net)
+    p.set_parameters(FlatParamsPolicy(net).init_parameters(jax.random.key(0)))
+    o1 = p(jnp.ones(3))
+    o2 = p(jnp.ones(3))
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    p.reset()
+    o3 = p(jnp.ones(3))
+    assert np.allclose(np.asarray(o1), np.asarray(o3))
+
+
+def test_policy_batched_recurrent_partial_reset():
+    net = LSTM(3, 4)
+    p = Policy(net)
+    flat = FlatParamsPolicy(net).init_parameters(jax.random.key(0))
+    p.set_parameters(jnp.stack([flat, flat]))
+    first = p(jnp.ones((2, 3)))
+    _ = p(jnp.ones((2, 3)))
+    # reset only env 0; env 1 keeps its state
+    p.reset(jnp.array([True, False]))
+    out = p(jnp.ones((2, 3)))
+    assert np.allclose(np.asarray(out[0]), np.asarray(first[0]), atol=1e-6)
+    assert not np.allclose(np.asarray(out[1]), np.asarray(first[1]))
+
+
+def test_reset_tensors():
+    tree = {"a": jnp.ones((4, 3)), "b": (jnp.full((4,), 7.0),)}
+    out = reset_tensors(tree, jnp.array([True, False, True, False]))
+    assert np.allclose(np.asarray(out["a"][0]), 0.0)
+    assert np.allclose(np.asarray(out["a"][1]), 1.0)
+    assert float(out["b"][0][0]) == 0.0
+    assert float(out["b"][0][1]) == 7.0
+
+
+# -- the jitted rollout engine ------------------------------------------------
+
+
+def _linear_policy(env):
+    net = Linear(env.observation_size, env.action_size) >> Tanh()
+    return FlatParamsPolicy(net)
+
+
+def test_rollout_shapes_and_accounting():
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    n = 8
+    params = jax.vmap(policy.init_parameters)(jax.random.split(jax.random.key(0), n))
+    stats = RunningNorm(env.observation_size).stats
+    result = run_vectorized_rollout(
+        env, policy, params, jax.random.key(1), stats, num_episodes=1
+    )
+    assert result.scores.shape == (n,)
+    assert int(result.total_episodes) == n
+    # cartpole returns are in [1, 500]
+    assert float(jnp.min(result.scores)) >= 1.0
+    assert float(jnp.max(result.scores)) <= 500.0
+    assert int(result.total_steps) >= n
+
+
+def test_rollout_num_episodes_mean():
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    params = jnp.zeros((4, policy.parameter_count))
+    stats = RunningNorm(env.observation_size).stats
+    r1 = run_vectorized_rollout(env, policy, params, jax.random.key(0), stats, num_episodes=3)
+    assert int(r1.total_episodes) == 12
+    # zero-params policy scores should be similar across episodes
+    assert r1.scores.shape == (4,)
+
+
+def test_rollout_episode_length_truncation():
+    env = Pendulum()
+    policy = _linear_policy(env)
+    params = jnp.zeros((3, policy.parameter_count))
+    stats = RunningNorm(env.observation_size).stats
+    result = run_vectorized_rollout(
+        env, policy, params, jax.random.key(0), stats, num_episodes=1, episode_length=10
+    )
+    assert int(result.total_steps) == 30  # 3 envs x 10 steps
+
+
+def test_rollout_observation_normalization_collects_stats():
+    env = Pendulum()
+    policy = _linear_policy(env)
+    params = jnp.zeros((2, policy.parameter_count))
+    stats = RunningNorm(env.observation_size).stats
+    result = run_vectorized_rollout(
+        env, policy, params, jax.random.key(0), stats,
+        num_episodes=1, episode_length=50, observation_normalization=True,
+    )
+    assert float(result.stats.count) == 100  # 2 envs x 50 steps
+
+
+def test_rollout_reward_adjustments():
+    env = Pendulum()
+    policy = _linear_policy(env)
+    params = jnp.zeros((2, policy.parameter_count))
+    stats = RunningNorm(env.observation_size).stats
+    base = run_vectorized_rollout(
+        env, policy, params, jax.random.key(0), stats, num_episodes=1, episode_length=20
+    )
+    adjusted = run_vectorized_rollout(
+        env, policy, params, jax.random.key(0), stats,
+        num_episodes=1, episode_length=20, decrease_rewards_by=1.0,
+    )
+    assert np.allclose(np.asarray(base.scores - adjusted.scores), 20.0, atol=1e-3)
+
+
+def test_rollout_recurrent_policy():
+    env = Pendulum()
+    net = RNN(env.observation_size, 8) >> Linear(8, env.action_size)
+    policy = FlatParamsPolicy(net)
+    params = jax.vmap(policy.init_parameters)(jax.random.split(jax.random.key(0), 3))
+    stats = RunningNorm(env.observation_size).stats
+    result = run_vectorized_rollout(
+        env, policy, params, jax.random.key(1), stats, num_episodes=1, episode_length=25
+    )
+    assert result.scores.shape == (3,)
