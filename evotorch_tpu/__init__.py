@@ -10,9 +10,9 @@ Package entry parity: reference ``src/evotorch/__init__.py:29-38`` re-exports
 ``Problem, Solution, SolutionBatch, ProblemBoundEvaluator`` and subpackages.
 """
 
-from . import decorators, distributions, envs, logging, neuroevolution, operators, optimizers, parallel, tools
+from . import checkpoint, decorators, distributions, envs, logging, models, neuroevolution, operators, ops, optimizers, parallel, testing, tools, utils
 from .core import Problem, ProblemBoundEvaluator, Solution, SolutionBatch, SolutionBatchPieces
-from .decorators import expects_ndim, on_aux_device, on_device, pass_info, rowwise, vectorized
+from .decorators import expects_ndim, on_aux_device, on_cuda, on_device, pass_info, rowwise, vectorized
 
 __all__ = [
     "Problem",
@@ -20,9 +20,14 @@ __all__ = [
     "Solution",
     "SolutionBatch",
     "SolutionBatchPieces",
+    "checkpoint",
     "decorators",
     "distributions",
     "envs",
+    "models",
+    "ops",
+    "testing",
+    "utils",
     "logging",
     "neuroevolution",
     "operators",
@@ -31,6 +36,7 @@ __all__ = [
     "tools",
     "expects_ndim",
     "on_aux_device",
+    "on_cuda",
     "on_device",
     "pass_info",
     "rowwise",

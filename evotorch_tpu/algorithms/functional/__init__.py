@@ -8,7 +8,10 @@ and batchable (extra leftmost dims on states/hyperparams = batched searches).
 from .funcadam import AdamState, adam, adam_ask, adam_tell
 from .funcclipup import ClipUpState, clipup, clipup_ask, clipup_tell
 from .funccem import CEMState, cem, cem_ask, cem_tell
+from .funccmaes import CMAESState, cmaes, cmaes_ask, cmaes_tell
 from .funcpgpe import PGPEState, pgpe, pgpe_ask, pgpe_tell
+from .funcsnes import SNESState, snes, snes_ask, snes_tell
+from .funcxnes import XNESState, xnes, xnes_ask, xnes_tell
 from .funcsgd import SGDState, sgd, sgd_ask, sgd_tell
 from .misc import OptimizerFunctions, get_functional_optimizer
 
@@ -25,10 +28,22 @@ __all__ = [
     "cem",
     "cem_ask",
     "cem_tell",
+    "CMAESState",
+    "cmaes",
+    "cmaes_ask",
+    "cmaes_tell",
     "PGPEState",
     "pgpe",
     "pgpe_ask",
     "pgpe_tell",
+    "SNESState",
+    "snes",
+    "snes_ask",
+    "snes_tell",
+    "XNESState",
+    "xnes",
+    "xnes_ask",
+    "xnes_tell",
     "SGDState",
     "sgd",
     "sgd_ask",

@@ -1,0 +1,91 @@
+"""Functional SNES: ``snes`` / ``snes_ask`` / ``snes_tell``.
+
+An extension over the reference's functional API (which offers only cem/pgpe,
+``algorithms/functional/__init__.py``): the same ask/tell pytree-state shape
+applied to SNES (Schaul et al. 2011), using the ``ExpSeparableGaussian``
+natural-gradient math of ``distributions.py`` (reference
+``distributions.py:776-810``) and the OO defaults of ``gaussian.py:746-983``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ...distributions import ExpSeparableGaussian, make_functional_grad_estimator
+from ...tools.misc import stdev_from_radius
+from ...tools.pytree import pytree_dataclass, replace, static_field
+from .misc import as_vector_like
+
+__all__ = ["SNESState", "snes", "snes_ask", "snes_tell"]
+
+
+@pytree_dataclass
+class SNESState:
+    center: jnp.ndarray
+    stdev: jnp.ndarray
+    center_learning_rate: jnp.ndarray
+    stdev_learning_rate: jnp.ndarray
+    ranking_method: str = static_field()
+    maximize: bool = static_field()
+
+
+def snes(
+    *,
+    center_init,
+    objective_sense: str,
+    stdev_init: Optional[Union[float, jnp.ndarray]] = None,
+    radius_init: Optional[Union[float, jnp.ndarray]] = None,
+    center_learning_rate: Optional[float] = None,
+    stdev_learning_rate: Optional[float] = None,
+    ranking_method: str = "nes",
+) -> SNESState:
+    """Initial SNES state with the reference's learning-rate heuristics
+    (popsize-independent; ``0.2 * (3 + log n) / sqrt(n)``)."""
+    center_init = jnp.asarray(center_init)
+    n = center_init.shape[-1]
+    if objective_sense not in ("min", "max"):
+        raise ValueError(f"objective_sense must be 'min' or 'max', got {objective_sense!r}")
+    if (stdev_init is None) == (radius_init is None):
+        raise ValueError("Exactly one of stdev_init / radius_init must be provided")
+    if radius_init is not None:
+        stdev_init = stdev_from_radius(float(radius_init), n)
+    if center_learning_rate is None:
+        center_learning_rate = 1.0
+    if stdev_learning_rate is None:
+        stdev_learning_rate = 0.2 * (3 + math.log(n)) / math.sqrt(n)
+    return SNESState(
+        center=center_init,
+        stdev=jnp.broadcast_to(as_vector_like(stdev_init, center_init, 0.0), center_init.shape),
+        center_learning_rate=jnp.asarray(center_learning_rate, dtype=center_init.dtype),
+        stdev_learning_rate=jnp.asarray(stdev_learning_rate, dtype=center_init.dtype),
+        ranking_method=str(ranking_method),
+        maximize=(objective_sense == "max"),
+    )
+
+
+def default_popsize(solution_length: int) -> int:
+    """``4 + floor(3 log n)`` (reference ``gaussian.py:948``)."""
+    return int(4 + math.floor(3 * math.log(solution_length)))
+
+
+def snes_ask(key, state: SNESState, *, popsize: int) -> jnp.ndarray:
+    return ExpSeparableGaussian.functional_sample(
+        int(popsize), {"mu": state.center, "sigma": state.stdev}, key=key
+    )
+
+
+def snes_tell(state: SNESState, values, evals) -> SNESState:
+    grad_fn = make_functional_grad_estimator(
+        ExpSeparableGaussian,
+        objective_sense=("max" if state.maximize else "min"),
+        ranking_method=state.ranking_method,
+    )
+    grads = grad_fn(values, evals, {"mu": state.center, "sigma": state.stdev})
+    center = state.center + state.center_learning_rate[..., None] * grads["mu"]
+    stdev = state.stdev * jnp.exp(
+        0.5 * state.stdev_learning_rate[..., None] * grads["sigma"]
+    )
+    return replace(state, center=center, stdev=stdev)

@@ -169,14 +169,21 @@ class SearchAlgorithm(LazyReporter):
         raise NotImplementedError
 
     def step(self):
-        """One generation (reference ``searchalgorithm.py:380-397``)."""
+        """One generation (reference ``searchalgorithm.py:380-397``).
+        Beyond the reference, per-generation wall-clock is published as
+        ``step_seconds`` (SURVEY.md §5: the reference has no tracing beyond
+        ``first_step_datetime``)."""
+        import time
+
         self._before_step_hook()
         self.clear_status()
         if self._first_step_datetime is None:
             self._first_step_datetime = datetime.now()
+        t0 = time.perf_counter()
         self._step()
+        step_seconds = time.perf_counter() - t0
         self._steps_count += 1
-        self.update_status({"iter": self._steps_count})
+        self.update_status({"iter": self._steps_count, "step_seconds": step_seconds})
         self.update_status(self._problem.status)
         extra = self._after_step_hook.accumulate_dict()
         if extra:
@@ -211,28 +218,16 @@ class SinglePopulationAlgorithmMixin:
         exclude = exclude or set()
         problem = self.problem
 
+        from functools import partial
+
         def make_getters(obj_index: int, prefix: str):
-            def pop_best():
-                batch = self.population
-                i = int(np.asarray(batch.argbest(obj_index)))
-                return batch[i].clone()
-
-            def pop_best_eval():
-                batch = self.population
-                i = int(np.asarray(batch.argbest(obj_index)))
-                return float(np.asarray(batch.evals[i, obj_index]))
-
-            def mean_eval():
-                return float(np.nanmean(np.asarray(self.population.evals[:, obj_index])))
-
-            def median_eval():
-                return float(np.nanmedian(np.asarray(self.population.evals[:, obj_index])))
-
+            # partials over bound methods (not closures) keep searchers
+            # picklable for whole-object checkpointing
             return {
-                f"{prefix}pop_best": pop_best,
-                f"{prefix}pop_best_eval": pop_best_eval,
-                f"{prefix}mean_eval": mean_eval,
-                f"{prefix}median_eval": median_eval,
+                f"{prefix}pop_best": partial(self._status_pop_best, obj_index),
+                f"{prefix}pop_best_eval": partial(self._status_pop_best_eval, obj_index),
+                f"{prefix}mean_eval": partial(self._status_mean_eval, obj_index),
+                f"{prefix}median_eval": partial(self._status_median_eval, obj_index),
             }
 
         # algorithms focused on a single objective (via their obj_index)
@@ -247,3 +242,19 @@ class SinglePopulationAlgorithmMixin:
         else:
             getters = make_getters(0 if algo_obj_index is None else int(algo_obj_index), "")
         self.update_status_getters({k: v for k, v in getters.items() if k not in exclude})
+
+    def _status_pop_best(self, obj_index: int):
+        batch = self.population
+        i = int(np.asarray(batch.argbest(obj_index)))
+        return batch[i].clone()
+
+    def _status_pop_best_eval(self, obj_index: int) -> float:
+        batch = self.population
+        i = int(np.asarray(batch.argbest(obj_index)))
+        return float(np.asarray(batch.evals[i, obj_index]))
+
+    def _status_mean_eval(self, obj_index: int) -> float:
+        return float(np.nanmean(np.asarray(self.population.evals[:, obj_index])))
+
+    def _status_median_eval(self, obj_index: int) -> float:
+        return float(np.nanmedian(np.asarray(self.population.evals[:, obj_index])))

@@ -1,0 +1,67 @@
+"""Checkpoint / resume.
+
+The reference's checkpointing is pickle-based: every core object is
+``Serializable`` and ``PicklingLogger`` periodically saves decision-making
+state (SURVEY.md §5). The TPU build adds what the reference lacks — a
+**mid-run algorithm-state resume API**: every functional algorithm state is a
+pytree, so it round-trips losslessly through orbax.
+
+- ``save_state`` / ``load_state``: orbax checkpoint of any pytree state
+  (PGPEState, CMAESState, CollectedStats, optimizer states, ...).
+- ``save_searcher`` / ``load_searcher``: pickle of a whole OO searcher
+  (problem + distribution + optimizer + counters), reference-style.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["save_state", "load_state", "save_searcher", "load_searcher"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_state(path: str, state: Any):
+    """Save a pytree state (functional algorithm/optimizer state) with orbax.
+    Static dataclass fields ride along automatically (they are part of the
+    treedef, which is reconstructed from the ``template`` at load time)."""
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    ckpt.save(path, state, force=True)
+    ckpt.wait_until_finished()
+
+
+def load_state(path: str, template: Any) -> Any:
+    """Restore a pytree state saved by :func:`save_state`. ``template`` is a
+    state of the same structure (e.g. a freshly initialized one) providing
+    the treedef, static fields, and array shapes/dtypes."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    target = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
+    restored = ckpt.restore(path, target)
+    # graft restored leaves back into the template (preserving static fields)
+    leaves, _ = jax.tree_util.tree_flatten(restored)
+    _, treedef = jax.tree_util.tree_flatten(template)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_searcher(path: str, searcher) -> str:
+    """Pickle a whole OO searcher (reference-style whole-object checkpoint)."""
+    with open(path, "wb") as f:
+        pickle.dump(searcher, f)
+    return path
+
+
+def load_searcher(path: str):
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -28,6 +28,7 @@ __all__ = [
     "pass_info",
     "on_device",
     "on_aux_device",
+    "on_cuda",
 ]
 
 
@@ -61,6 +62,15 @@ def on_aux_device(fn: Optional[Callable] = None):
     if fn is None:
         return on_device("aux")
     return on_device("aux")(fn)
+
+
+def on_cuda(fn: Optional[Callable] = None):
+    """Marker-only parity shim for the reference's ``@on_cuda``
+    (``decorators.py:350``-ish): on TPU there is no CUDA device; the marker
+    maps to the accelerator device (placement is via shardings anyway)."""
+    if fn is None:
+        return on_device("accelerator")
+    return on_device("accelerator")(fn)
 
 
 def _tree_first_leaf(x):

@@ -1,0 +1,26 @@
+"""Ready-made policy model families.
+
+The reference ships its model zoo inside ``neuroevolution/net/layers.py``
+(MLPs via ``FeedForwardNet``, ``StructuredControlNet``, ``LocomotorNet``,
+single-step RNN/LSTM). This package packages those into policy factories with
+evolution-friendly defaults, for use as ``VecNE``/``GymNE`` network specs or
+standalone.
+"""
+
+from .policies import (
+    LinearPolicy,
+    LSTMPolicy,
+    MLPPolicy,
+    RNNPolicy,
+    locomotor_policy,
+    structured_control_policy,
+)
+
+__all__ = [
+    "LinearPolicy",
+    "LSTMPolicy",
+    "MLPPolicy",
+    "RNNPolicy",
+    "locomotor_policy",
+    "structured_control_policy",
+]

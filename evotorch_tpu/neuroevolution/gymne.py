@@ -203,11 +203,14 @@ class GymNE(NEProblem):
 
     # ------------------------------------------------------- policy exports
     def to_policy(self, solution) -> Module:
-        """Deployable module: obs-norm + network + action clip
+        """Deployable module **carrying the solution's evolved weights**:
+        obs-norm + parameterized network + action clip
         (reference ``gymne.py:646-672``)."""
+        from .net.layers import FrozenModule
         from .net.rl import ActClipLayer, ObsNormLayer
 
-        module = self._net_module
+        values = jnp.asarray(solution.values if hasattr(solution, "values") else solution)
+        module: Module = FrozenModule(self._net_module, self._policy.unravel(values))
         if self._observation_normalization and self._obs_stats.count >= 2:
             module = (
                 ObsNormLayer(mean=self._obs_stats.mean, stdev=self._obs_stats.stdev)

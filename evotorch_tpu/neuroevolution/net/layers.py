@@ -108,6 +108,38 @@ class Sequential(Module):
         return " >> ".join(repr(m) for m in self.modules)
 
 
+class FrozenModule(Module):
+    """A module with its parameters baked in: ``init`` returns an empty
+    parameter pytree and ``apply`` ignores the params argument. Used by
+    ``to_policy`` exports so a deployable policy carries its evolved weights
+    (the analog of the reference's parameterized-net wrappers,
+    ``gymne.py:646-672``)."""
+
+    def __init__(self, module: Module, params):
+        self._module = module
+        self._params = params
+
+    def init(self, key):
+        return ()
+
+    def initial_state(self):
+        return self._module.initial_state()
+
+    def apply(self, params, x, state=None):
+        return self._module.apply(self._params, x, state)
+
+    @property
+    def wrapped_module(self) -> Module:
+        return self._module
+
+    @property
+    def wrapped_params(self):
+        return self._params
+
+    def __repr__(self):
+        return f"FrozenModule({self._module!r})"
+
+
 class Linear(Module):
     """Dense layer; initialization mirrors torch's ``nn.Linear`` default
     (uniform +-1/sqrt(fan_in)), keeping evolved-policy scales comparable to

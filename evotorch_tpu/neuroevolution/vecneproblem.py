@@ -26,8 +26,8 @@ from ..envs import Env, make_env
 from ..parallel.mesh import default_mesh
 from .neproblem import NEProblem
 from .net.layers import Module
-from .net.rl import ActClipLayer, ObsNormLayer
-from .net.runningnorm import CollectedStats, RunningNorm, stats_merge
+from .net.rl import ActClipLayer
+from .net.runningnorm import RunningNorm
 from .net.vecrl import run_vectorized_rollout
 
 __all__ = ["VecNE", "VecGymNE"]
@@ -157,10 +157,14 @@ class VecNE(NEProblem):
 
     # ------------------------------------------------------- policy exports
     def to_policy(self, solution) -> Module:
-        """Wrap a solution as a deployable policy module: obs-norm layer (if
-        any statistics were collected) + network + action clipping
+        """Wrap a solution as a deployable policy module **carrying the
+        solution's evolved weights** (a FrozenModule): obs-norm layer (if any
+        statistics were collected) + parameterized network + action clipping
         (reference ``gymne.py:646-672`` / ``vecgymne.py:949-1010``)."""
-        module = self._net_module
+        from .net.layers import FrozenModule
+
+        values = jnp.asarray(solution.values if hasattr(solution, "values") else solution)
+        module: Module = FrozenModule(self._net_module, self._policy.unravel(values))
         if self._observation_normalization and self._obs_norm.count >= 2:
             module = self._obs_norm.to_layer() >> module
         space = self._env.action_space

@@ -1,0 +1,21 @@
+"""Pallas TPU kernels for the hot ops.
+
+The compute-heavy paths of this framework (population matmuls, rollouts) are
+already MXU-shaped through XLA; these kernels cover the ops where explicit
+VMEM scheduling wins:
+
+- ``sample_symmetric_gaussian``: fused on-chip sampling of antithetic
+  populations (PRNG + scale + interleave without HBM round-trips for the
+  noise tensor) — the `ask` hot-op of PGPE at popsize 10k+.
+- ``fused_centered_rank``: rank -> centered-utility transform fused over a
+  fitness vector.
+
+Every kernel has an XLA fallback (used automatically on CPU or when Pallas
+lowering is unavailable), so behavior is identical everywhere; tests exercise
+the kernels in Pallas interpret mode.
+"""
+
+from .sampling import sample_symmetric_gaussian
+from .ranking import fused_centered_rank
+
+__all__ = ["sample_symmetric_gaussian", "fused_centered_rank"]

@@ -1,0 +1,62 @@
+"""Fused centered-rank utility kernel.
+
+Transforms a fitness vector into centered utilities (``tools/ranking.py``
+semantics) with the rank scatter fused in one kernel. The XLA fallback is the
+library implementation; the Pallas path is a drop-in for very large
+populations where the double-argsort's intermediate tensors matter.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..tools.ranking import centered as _xla_centered
+
+__all__ = ["fused_centered_rank"]
+
+
+def _pallas_kernel(fit_ref, out_ref):
+    fit = fit_ref[:]  # one fitness vector (batch dims handled by vmap)
+    n = fit.shape[-1]
+    # rank of each element = number of strictly-smaller elements plus the
+    # number of equal elements appearing earlier (stable tie-break), computed
+    # as one O(n^2) comparison block living entirely in VMEM — beats the
+    # double argsort's three HBM round-trips for mid-sized populations
+    col = fit[:, None]
+    row = fit[None, :]
+    idx = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    jdx = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    smaller = (row < col) | ((row == col) & (jdx < idx))
+    ranks = jnp.sum(smaller.astype(jnp.float32), axis=-1)
+    out_ref[:] = ranks / (n - 1) - 0.5
+
+
+@functools.partial(jax.jit, static_argnames=("higher_is_better", "use_pallas", "interpret"))
+def fused_centered_rank(
+    fitnesses: jnp.ndarray,
+    *,
+    higher_is_better: bool = True,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Centered ranks in ``[-0.5, 0.5]`` along the last axis."""
+    x = jnp.asarray(fitnesses)
+    if not use_pallas:
+        return _xla_centered(x, higher_is_better=higher_is_better)
+
+    from jax.experimental import pallas as pl
+
+    signed = (x if higher_is_better else -x).astype(jnp.float32)
+    batch_shape = signed.shape[:-1]
+    flat = signed.reshape((-1, signed.shape[-1]))
+
+    call = pl.pallas_call(
+        _pallas_kernel,
+        out_shape=jax.ShapeDtypeStruct((signed.shape[-1],), jnp.float32),
+        interpret=interpret,
+    )
+    out = jax.vmap(call)(flat)
+    return out.reshape(batch_shape + (signed.shape[-1],)) if batch_shape else out[0]

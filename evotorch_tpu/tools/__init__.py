@@ -3,7 +3,7 @@
 Parity target: reference ``src/evotorch/tools/`` (SURVEY.md §2.8).
 """
 
-from . import cloning, constraints, hook, immutable, misc, objectarray, ranking
+from . import cloning, constraints, hook, immutable, misc, objectarray, pytree, ranking, readonlytensor, structures, tensorframe
 from .cloning import Clonable, ReadOnlyClonable, Serializable, deep_clone
 from .constraints import log_barrier, penalty, violation
 from .hook import Hook
@@ -39,6 +39,10 @@ from .misc import (
     to_stdev_init,
 )
 from .objectarray import ObjectArray
+from .pytree import pytree_dataclass, replace, static_field
+from .structures import CBag, CDict, CList, CMemory, do_where
+from .readonlytensor import ReadOnlyTensor, as_read_only_tensor, read_only_tensor
+from .tensorframe import Picker, TensorFrame
 from .ranking import rank, rankers
 from .recursiveprintable import RecursivePrintable
 from .tensormaker import TensorMakerMixin
@@ -80,6 +84,19 @@ __all__ = [
     "to_numpy_dtype",
     "to_stdev_init",
     "ObjectArray",
+    "pytree_dataclass",
+    "replace",
+    "static_field",
+    "CBag",
+    "CDict",
+    "CList",
+    "CMemory",
+    "do_where",
+    "Picker",
+    "TensorFrame",
+    "ReadOnlyTensor",
+    "as_read_only_tensor",
+    "read_only_tensor",
     "rank",
     "rankers",
     "RecursivePrintable",

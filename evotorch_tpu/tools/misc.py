@@ -43,6 +43,7 @@ __all__ = [
     "pass_through",
     "expect_none",
     "message_from",
+    "set_default_logger_config",
 ]
 
 Device = Any
@@ -319,3 +320,24 @@ def expect_none(msg_prefix: str, **kwargs):
 
 def message_from(sender: Any, message: str) -> str:
     return f"[{type(sender).__name__}] {message}"
+
+
+def set_default_logger_config(level: Optional[Union[int, str]] = None):
+    """Configure the "evotorch_tpu" python logging channel (reference
+    ``misc.py:2072-2142`` ``set_default_logger_config``; verbosity also
+    settable via the ``EVOTORCH_TPU_VERBOSE_LEVEL`` env var, the analog of
+    ``EVOTORCH_VERBOSE_LEVEL``, reference ``__init__.py:42-53``)."""
+    import logging as _logging
+    import os as _os
+
+    logger = _logging.getLogger("evotorch_tpu")
+    if level is None:
+        level = _os.environ.get("EVOTORCH_TPU_VERBOSE_LEVEL", "INFO")
+    if isinstance(level, str) and level.isdigit():
+        level = int(level)
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = _logging.StreamHandler()
+        handler.setFormatter(_logging.Formatter("[%(asctime)s] %(levelname)s <%(name)s> %(message)s"))
+        logger.addHandler(handler)
+    return logger

@@ -1,0 +1,47 @@
+"""Read-only tensor shims.
+
+Parity: reference ``tools/readonlytensor.py:27-226`` (``ReadOnlyTensor``,
+``read_only_tensor``, ``as_read_only_tensor``). The reference subclasses
+``torch.Tensor`` to block in-place mutation; **jax.Arrays are immutable by
+construction**, so the read-only discipline holds for every array in this
+framework and these helpers reduce to coercions (numpy inputs are returned as
+write-protected views).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ReadOnlyTensor", "read_only_tensor", "as_read_only_tensor", "is_read_only"]
+
+# every jax.Array is already read-only
+ReadOnlyTensor = jax.Array
+
+
+def read_only_tensor(x: Any, *, dtype=None) -> jax.Array:
+    """A read-only (jax) array holding a copy of ``x``."""
+    return jnp.asarray(x, dtype=dtype)
+
+
+def as_read_only_tensor(x: Any, *, dtype=None) -> Any:
+    """Coerce to a read-only view: jax arrays pass through; numpy arrays are
+    returned as non-writeable views; others are converted to jax arrays."""
+    if isinstance(x, jax.Array):
+        return x
+    if isinstance(x, np.ndarray) and (dtype is None or x.dtype == np.dtype(dtype)):
+        view = x.view()
+        view.setflags(write=False)
+        return view
+    return jnp.asarray(x, dtype=dtype)
+
+
+def is_read_only(x: Any) -> bool:
+    if isinstance(x, jax.Array):
+        return True
+    if isinstance(x, np.ndarray):
+        return not x.flags.writeable
+    return False

@@ -1,0 +1,289 @@
+"""Vectorization-friendly batched data structures.
+
+Parity: reference ``tools/structures.py`` (2457 LoC) — ``CMemory``
+(``structures.py:60-786``), ``CDict`` (``structures.py:892``), ``CList``
+(circular-buffer list, ``structures.py:1380``), ``CBag``
+(``structures.py:2024``), ``do_where`` (``structures.py:33``). All contiguous
+tensors with masked updates, usable under ``vmap``/``jit``.
+
+TPU-first deviation: jax arrays are immutable, so the reference's in-place
+methods (``set_``, ``add_``, ``append_``, ...) here RETURN the updated
+structure (pytree dataclasses) instead of mutating; the trailing-underscore
+names are kept so reference code maps 1:1 after adding an assignment. Batch
+dimensions come from ``vmap`` (every method is per-instance and pure) rather
+than explicit batch shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .pytree import pytree_dataclass, replace, static_field
+
+__all__ = ["do_where", "CMemory", "CDict", "CList", "CBag"]
+
+
+def do_where(mask, a: Any, b: Any) -> Any:
+    """Pytree-wide ``where`` (reference ``structures.py:33``)."""
+
+    def pick(x, y):
+        m = jnp.reshape(mask, jnp.shape(mask) + (1,) * (jnp.ndim(x) - jnp.ndim(mask)))
+        return jnp.where(m, x, y)
+
+    return jax.tree_util.tree_map(pick, a, b)
+
+
+@pytree_dataclass
+class CMemory:
+    """Batched key -> tensor memory with masked updates
+    (reference ``structures.py:60``). Keys are integers in ``[0, num_keys)``."""
+
+    data: jnp.ndarray  # (num_keys, *value_shape)
+
+    @staticmethod
+    def create(num_keys: int, *value_shape: int, dtype=jnp.float32, fill: float = 0.0) -> "CMemory":
+        return CMemory(
+            data=jnp.full((int(num_keys),) + tuple(int(s) for s in value_shape), fill, dtype=dtype)
+        )
+
+    @property
+    def num_keys(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def value_shape(self) -> tuple:
+        return self.data.shape[1:]
+
+    def get(self, key, default=None) -> jnp.ndarray:
+        key = jnp.asarray(key)
+        value = self.data[key]
+        if default is not None:
+            valid = (key >= 0) & (key < self.num_keys)
+            value = do_where(valid, value, jnp.broadcast_to(jnp.asarray(default, self.data.dtype), value.shape))
+        return value
+
+    def __getitem__(self, key) -> jnp.ndarray:
+        return self.get(key)
+
+    def _masked_update(self, key, new_value, where) -> "CMemory":
+        key = jnp.asarray(key)
+        new_value = jnp.broadcast_to(jnp.asarray(new_value, self.data.dtype), self.value_shape)
+        if where is None:
+            return replace(self, data=self.data.at[key].set(new_value))
+        current = self.data[key]
+        masked = do_where(jnp.asarray(where), new_value, current)
+        return replace(self, data=self.data.at[key].set(masked))
+
+    def set_(self, key, value, where=None) -> "CMemory":
+        """Masked overwrite (reference ``structures.py:300``-ish ``set_``)."""
+        return self._masked_update(key, value, where)
+
+    def add_(self, key, value, where=None) -> "CMemory":
+        return self._masked_update(key, self.data[jnp.asarray(key)] + jnp.asarray(value, self.data.dtype), where)
+
+    def subtract_(self, key, value, where=None) -> "CMemory":
+        return self._masked_update(key, self.data[jnp.asarray(key)] - jnp.asarray(value, self.data.dtype), where)
+
+    def multiply_(self, key, value, where=None) -> "CMemory":
+        return self._masked_update(key, self.data[jnp.asarray(key)] * jnp.asarray(value, self.data.dtype), where)
+
+    def divide_(self, key, value, where=None) -> "CMemory":
+        return self._masked_update(key, self.data[jnp.asarray(key)] / jnp.asarray(value, self.data.dtype), where)
+
+
+@pytree_dataclass
+class CDict:
+    """CMemory with a static hashable-key namespace
+    (reference ``structures.py:892``)."""
+
+    memory: CMemory
+    keys: tuple = static_field()
+
+    @staticmethod
+    def create(keys, *value_shape: int, dtype=jnp.float32, fill: float = 0.0) -> "CDict":
+        keys = tuple(keys)
+        return CDict(
+            memory=CMemory.create(len(keys), *value_shape, dtype=dtype, fill=fill),
+            keys=keys,
+        )
+
+    def _index(self, key) -> int:
+        try:
+            return self.keys.index(key)
+        except ValueError:
+            raise KeyError(f"Unknown key: {key!r} (known: {self.keys})") from None
+
+    def get(self, key, default=None) -> jnp.ndarray:
+        return self.memory.get(self._index(key), default)
+
+    def __getitem__(self, key) -> jnp.ndarray:
+        return self.get(key)
+
+    def set_(self, key, value, where=None) -> "CDict":
+        return replace(self, memory=self.memory.set_(self._index(key), value, where))
+
+    def add_(self, key, value, where=None) -> "CDict":
+        return replace(self, memory=self.memory.add_(self._index(key), value, where))
+
+
+@pytree_dataclass
+class CList:
+    """Fixed-capacity circular-buffer list with masked push/pop
+    (reference ``structures.py:1380``)."""
+
+    data: jnp.ndarray  # (capacity, *value_shape)
+    begin: jnp.ndarray  # scalar int32
+    length: jnp.ndarray  # scalar int32
+
+    @staticmethod
+    def create(capacity: int, *value_shape: int, dtype=jnp.float32) -> "CList":
+        return CList(
+            data=jnp.zeros((int(capacity),) + tuple(int(s) for s in value_shape), dtype=dtype),
+            begin=jnp.zeros((), jnp.int32),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def __len__(self):
+        raise TypeError("Use .length (a traced scalar) instead of len() on a CList")
+
+    @property
+    def is_empty(self) -> jnp.ndarray:
+        return self.length == 0
+
+    @property
+    def is_full(self) -> jnp.ndarray:
+        return self.length == self.capacity
+
+    def _phys(self, i) -> jnp.ndarray:
+        return (self.begin + jnp.asarray(i)) % self.capacity
+
+    def get(self, i, default=None) -> jnp.ndarray:
+        i = jnp.asarray(i)
+        i = jnp.where(i < 0, i + self.length, i)
+        value = self.data[self._phys(i)]
+        if default is not None:
+            valid = (i >= 0) & (i < self.length)
+            value = do_where(valid, value, jnp.broadcast_to(jnp.asarray(default, self.data.dtype), value.shape))
+        return value
+
+    def __getitem__(self, i) -> jnp.ndarray:
+        return self.get(i)
+
+    def set_(self, i, value, where=None) -> "CList":
+        i = jnp.asarray(i)
+        i = jnp.where(i < 0, i + self.length, i)
+        valid = (i >= 0) & (i < self.length)
+        if where is not None:
+            valid = valid & jnp.asarray(where)
+        current = self.data[self._phys(i)]
+        masked = do_where(valid, jnp.asarray(value, self.data.dtype), current)
+        return replace(self, data=self.data.at[self._phys(i)].set(masked))
+
+    def append_(self, value, where=None) -> "CList":
+        """Push to the end unless full (masked; reference ``push_``)."""
+        can = ~self.is_full
+        if where is not None:
+            can = can & jnp.asarray(where)
+        pos = self._phys(self.length)
+        current = self.data[pos]
+        new_val = do_where(can, jnp.broadcast_to(jnp.asarray(value, self.data.dtype), current.shape), current)
+        return replace(
+            self,
+            data=self.data.at[pos].set(new_val),
+            length=self.length + can.astype(jnp.int32),
+        )
+
+    def appendleft_(self, value, where=None) -> "CList":
+        can = ~self.is_full
+        if where is not None:
+            can = can & jnp.asarray(where)
+        new_begin = jnp.where(can, (self.begin - 1) % self.capacity, self.begin)
+        current = self.data[new_begin]
+        new_val = do_where(can, jnp.broadcast_to(jnp.asarray(value, self.data.dtype), current.shape), current)
+        return replace(
+            self,
+            data=self.data.at[new_begin].set(new_val),
+            begin=new_begin,
+            length=self.length + can.astype(jnp.int32),
+        )
+
+    def pop_(self, where=None) -> tuple:
+        """Pop from the end (masked); returns ``(new_list, value)`` where the
+        value is the popped item (stale data when the pop was masked out)."""
+        can = ~self.is_empty
+        if where is not None:
+            can = can & jnp.asarray(where)
+        pos = self._phys(jnp.maximum(self.length - 1, 0))
+        value = self.data[pos]
+        return replace(self, length=self.length - can.astype(jnp.int32)), value
+
+    def popleft_(self, where=None) -> tuple:
+        can = ~self.is_empty
+        if where is not None:
+            can = can & jnp.asarray(where)
+        value = self.data[self.begin]
+        new_begin = jnp.where(can, (self.begin + 1) % self.capacity, self.begin)
+        return (
+            replace(self, begin=new_begin, length=self.length - can.astype(jnp.int32)),
+            value,
+        )
+
+
+@pytree_dataclass
+class CBag:
+    """A bag (multiset) of integers in ``[0, num_keys)`` with random pop
+    (reference ``structures.py:2024``)."""
+
+    counts: jnp.ndarray  # (num_keys,) int32
+
+    @staticmethod
+    def create(num_keys: int) -> "CBag":
+        return CBag(counts=jnp.zeros(int(num_keys), dtype=jnp.int32))
+
+    @property
+    def num_keys(self) -> int:
+        return self.counts.shape[0]
+
+    @property
+    def total(self) -> jnp.ndarray:
+        return jnp.sum(self.counts)
+
+    def push_(self, key, where=None) -> "CBag":
+        key = jnp.asarray(key)
+        inc = jnp.ones((), jnp.int32) if where is None else jnp.asarray(where).astype(jnp.int32)
+        return replace(self, counts=self.counts.at[key].add(inc))
+
+    def pop_(self, key_or_rng, where=None) -> tuple:
+        """Pop a specific key (int) or a uniformly random present key (PRNG
+        key, typed or legacy uint32). Returns ``(new_bag, popped_key, ok)``."""
+        is_legacy_prng = (
+            hasattr(key_or_rng, "dtype")
+            and jnp.asarray(key_or_rng).dtype == jnp.uint32
+            and jnp.asarray(key_or_rng).shape == (2,)
+        )
+        if is_legacy_prng:
+            key_or_rng = jax.random.wrap_key_data(jnp.asarray(key_or_rng))
+        if isinstance(key_or_rng, (int,)) or (
+            hasattr(key_or_rng, "dtype")
+            and jnp.issubdtype(jnp.asarray(key_or_rng).dtype, jnp.integer)
+            and jnp.asarray(key_or_rng).ndim == 0
+        ):
+            key = jnp.asarray(key_or_rng)
+            ok = self.counts[key] > 0
+        else:
+            probs = self.counts.astype(jnp.float32)
+            total = jnp.sum(probs)
+            safe = jnp.where(total > 0, probs / jnp.maximum(total, 1), jnp.ones_like(probs) / self.num_keys)
+            key = jax.random.choice(key_or_rng, self.num_keys, p=safe)
+            ok = total > 0
+        if where is not None:
+            ok = ok & jnp.asarray(where)
+        dec = ok.astype(jnp.int32)
+        return replace(self, counts=self.counts.at[key].add(-dec)), key, ok

@@ -1,0 +1,146 @@
+"""``TensorFrame``: a pandas-like columnar table of device arrays.
+
+Parity: reference ``tools/tensorframe.py:53-1338`` (columnar table of
+tensors, vmap-compatible, with the ``Picker`` row indexer). Implemented as a
+pytree dataclass of named equal-length columns, so whole frames pass through
+``jit``/``vmap``/``scan``; mutating operations return new frames.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .pytree import pytree_dataclass, static_field
+
+__all__ = ["TensorFrame", "Picker"]
+
+
+def _as_column(v, length: Optional[int]) -> jnp.ndarray:
+    arr = jnp.asarray(v)
+    if arr.ndim == 0 and length is not None:
+        arr = jnp.broadcast_to(arr, (length,))
+    return arr
+
+
+@pytree_dataclass
+class TensorFrame:
+    columns: tuple = static_field()
+    data: tuple = ()  # arrays aligned with `columns`
+
+    # ------------------------------------------------------------- factories
+    @staticmethod
+    def create(data: Optional[Dict[str, Any]] = None, **kwargs) -> "TensorFrame":
+        items = dict(data or {}, **kwargs)
+        length = None
+        for v in items.values():
+            arr = jnp.asarray(v)
+            if arr.ndim > 0:
+                length = arr.shape[0]
+                break
+        cols = tuple(items.keys())
+        arrays = tuple(_as_column(v, length) for v in items.values())
+        lengths = {a.shape[0] for a in arrays if a.ndim > 0}
+        if len(lengths) > 1:
+            raise ValueError(f"Columns have differing lengths: {lengths}")
+        return TensorFrame(columns=cols, data=arrays)
+
+    # ------------------------------------------------------------ properties
+    def __len__(self) -> int:
+        for a in self.data:
+            if a.ndim > 0:
+                return int(a.shape[0])
+        return 0
+
+    @property
+    def column_names(self) -> tuple:
+        return self.columns
+
+    def as_dict(self) -> Dict[str, jnp.ndarray]:
+        return dict(zip(self.columns, self.data))
+
+    # --------------------------------------------------------------- columns
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            try:
+                return self.data[self.columns.index(key)]
+            except ValueError:
+                raise KeyError(f"No column named {key!r} (have {self.columns})") from None
+        # boolean mask / index array / slice row selection
+        return self.pick[key]
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self.columns:
+            return self.data[self.columns.index(name)]
+        raise AttributeError(name)
+
+    def with_columns(self, **new_columns) -> "TensorFrame":
+        """Frame with columns added or replaced (functional assignment)."""
+        length = len(self) if self.data else None
+        items = self.as_dict()
+        for k, v in new_columns.items():
+            items[k] = _as_column(v, length)
+        return TensorFrame(columns=tuple(items.keys()), data=tuple(items.values()))
+
+    def without_columns(self, *names) -> "TensorFrame":
+        items = {k: v for k, v in self.as_dict().items() if k not in names}
+        return TensorFrame(columns=tuple(items.keys()), data=tuple(items.values()))
+
+    # ----------------------------------------------------------------- rows
+    @property
+    def pick(self) -> "Picker":
+        """Row indexer (reference ``Picker``): ``frame.pick[mask_or_indices]``."""
+        return Picker(self)
+
+    def take(self, indices) -> "TensorFrame":
+        indices = jnp.asarray(indices)
+        return TensorFrame(
+            columns=self.columns,
+            data=tuple(a[indices] for a in self.data),
+        )
+
+    def sort_values(self, by: str, *, descending: bool = False) -> "TensorFrame":
+        key = self[by]
+        order = jnp.argsort(-key if descending else key)
+        return self.take(order)
+
+    def concat(self, other: "TensorFrame") -> "TensorFrame":
+        if self.columns != other.columns:
+            raise ValueError("Cannot concat frames with different columns")
+        return TensorFrame(
+            columns=self.columns,
+            data=tuple(jnp.concatenate([a, b]) for a, b in zip(self.data, other.data)),
+        )
+
+    # ---------------------------------------------------------------- output
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame({k: np.asarray(v) for k, v in self.as_dict().items()})
+
+    def __repr__(self) -> str:
+        parts = ", ".join(f"{k}: {tuple(v.shape)}" for k, v in self.as_dict().items())
+        return f"<TensorFrame len={len(self)} {{{parts}}}>"
+
+
+class Picker:
+    """Row indexer over a TensorFrame (reference ``tensorframe.py`` ``Picker``)."""
+
+    def __init__(self, frame: TensorFrame):
+        self._frame = frame
+
+    def __getitem__(self, selector) -> TensorFrame:
+        frame = self._frame
+        if isinstance(selector, slice):
+            return TensorFrame(
+                columns=frame.columns, data=tuple(a[selector] for a in frame.data)
+            )
+        selector = jnp.asarray(selector)
+        if selector.dtype == jnp.bool_:
+            selector = jnp.nonzero(selector)[0]
+        return frame.take(selector)

@@ -1,0 +1,23 @@
+"""Alias module: ``evotorch_tpu.utils`` is ``evotorch_tpu.tools``.
+
+The reference names this layer ``tools`` (``src/evotorch/tools/``); both the
+symbols and the submodules resolve under either name.
+"""
+
+from . import tools as _tools
+from .tools import *  # noqa: F401,F403
+from .tools import (  # noqa: F401 — submodules reachable via the alias too
+    cloning,
+    constraints,
+    hook,
+    immutable,
+    misc,
+    objectarray,
+    pytree,
+    ranking,
+    readonlytensor,
+    structures,
+    tensorframe,
+)
+
+__all__ = _tools.__all__

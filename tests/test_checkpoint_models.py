@@ -1,0 +1,101 @@
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu import Problem, vectorized
+from evotorch_tpu.algorithms.functional import pgpe, pgpe_ask, pgpe_tell, snes, snes_ask, snes_tell
+from evotorch_tpu.checkpoint import load_searcher, load_state, save_searcher, save_state
+from evotorch_tpu.models import LSTMPolicy, MLPPolicy, RNNPolicy, locomotor_policy
+from evotorch_tpu.neuroevolution.net import FlatParamsPolicy
+
+
+def sphere(x):
+    return jnp.sum(x**2, axis=-1)
+
+
+def test_models_factories():
+    for factory in (MLPPolicy, RNNPolicy, LSTMPolicy, locomotor_policy):
+        net = factory(4, 2)
+        policy = FlatParamsPolicy(net)
+        flat = policy.init_parameters(jax.random.key(0))
+        y, _ = policy(flat, jnp.ones(4))
+        assert y.shape == (2,)
+
+
+def test_mlp_policy_hidden_config():
+    net = MLPPolicy(3, 1, hidden=(8,))
+    policy = FlatParamsPolicy(net)
+    assert policy.parameter_count == 3 * 8 + 8 + 8 * 1 + 1
+
+
+def test_functional_state_checkpoint_roundtrip(tmp_path):
+    state = pgpe(
+        center_init=jnp.full((5,), 2.0),
+        center_learning_rate=0.2,
+        stdev_learning_rate=0.1,
+        objective_sense="min",
+        stdev_init=1.0,
+    )
+    key = jax.random.key(0)
+    for _ in range(5):
+        key, sub = jax.random.split(key)
+        pop = pgpe_ask(sub, state, popsize=20)
+        state = pgpe_tell(state, pop, sphere(pop))
+
+    path = os.path.join(tmp_path, "pgpe_state")
+    save_state(path, state)
+    template = pgpe(
+        center_init=jnp.zeros(5),
+        center_learning_rate=0.2,
+        stdev_learning_rate=0.1,
+        objective_sense="min",
+        stdev_init=1.0,
+    )
+    restored = load_state(path, template)
+    assert np.allclose(
+        np.asarray(restored.optimizer_state.center), np.asarray(state.optimizer_state.center)
+    )
+    assert restored.maximize == state.maximize
+    # the restored state continues the run seamlessly
+    key, sub = jax.random.split(key)
+    pop = pgpe_ask(sub, restored, popsize=20)
+    restored = pgpe_tell(restored, pop, sphere(pop))
+
+
+@vectorized
+def _sphere_fitness(xs):
+    return jnp.sum(xs**2, axis=-1)
+
+
+def test_searcher_pickle_checkpoint(tmp_path):
+    from evotorch_tpu.algorithms import SNES
+
+    p = Problem("min", _sphere_fitness, solution_length=6, initial_bounds=(-3, 3), seed=0)
+    searcher = SNES(p, stdev_init=2.0)
+    searcher.run(5)
+    best_before = searcher.status["best_eval"]
+
+    path = os.path.join(tmp_path, "searcher.pkl")
+    save_searcher(path, searcher)
+    restored = load_searcher(path)
+    assert restored.step_count == 5
+    assert restored.status["best_eval"] == best_before
+    restored.run(5)
+    assert restored.step_count == 10
+    assert restored.status["best_eval"] <= best_before
+
+
+def test_step_seconds_in_status():
+    @vectorized
+    def fitness(xs):
+        return jnp.sum(xs**2, axis=-1)
+
+    from evotorch_tpu.algorithms import CEM
+
+    p = Problem("min", fitness, solution_length=4, initial_bounds=(-1, 1))
+    s = CEM(p, popsize=10, parenthood_ratio=0.5, stdev_init=1.0)
+    s.step()
+    assert s.status["step_seconds"] > 0

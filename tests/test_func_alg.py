@@ -141,3 +141,41 @@ def test_func_alg_under_jit_scan():
         ask=pgpe_ask, tell=pgpe_tell, fitness=sphere, popsize=40, num_generations=75,
     )
     assert float(means2[-1]) < float(means1[0])
+
+
+def test_functional_snes_and_xnes():
+    from evotorch_tpu.algorithms.functional import snes, snes_ask, snes_tell, xnes, xnes_ask, xnes_tell
+
+    s = snes(center_init=jnp.full((6,), 3.0), objective_sense="min", stdev_init=1.0)
+    s, _ = run_functional_search(
+        s, jax.random.key(0),
+        ask=snes_ask, tell=snes_tell, fitness=sphere, popsize=20, num_generations=150,
+    )
+    assert float(sphere(s.center[None])[0]) < 1e-3
+
+    x = xnes(center_init=jnp.full((5,), 3.0), objective_sense="min", stdev_init=1.0)
+    x, _ = run_functional_search(
+        x, jax.random.key(1),
+        ask=xnes_ask, tell=xnes_tell, fitness=sphere, popsize=20, num_generations=200,
+    )
+    assert float(sphere(x.center[None])[0]) < 1e-3
+
+
+def test_batched_xnes_and_snes():
+    from evotorch_tpu.algorithms.functional import snes, snes_ask, snes_tell, xnes, xnes_ask, xnes_tell
+
+    targets = jnp.array([[0.0] * 4, [2.0] * 4])
+    fitness = lambda pop: sphere(pop - targets[:, None, :])  # noqa: E731
+
+    for init, ask, tell in (
+        (snes, snes_ask, snes_tell),
+        (xnes, xnes_ask, xnes_tell),
+    ):
+        state = init(center_init=jnp.ones((2, 4)), objective_sense="min", stdev_init=1.0)
+        pop = ask(jax.random.key(0), state, popsize=16)
+        assert pop.shape == (2, 16, 4)
+        state, _ = run_functional_search(
+            state, jax.random.key(1),
+            ask=ask, tell=tell, fitness=fitness, popsize=16, num_generations=120,
+        )
+        assert np.allclose(np.asarray(state.center), np.asarray(targets), atol=0.5)

@@ -241,3 +241,23 @@ def test_gymne_observation_normalization(tmp_path):
     with open(fname, "rb") as f:
         payload = pickle.load(f)
     assert payload["obs_mean"] is not None
+
+
+def test_to_policy_carries_evolved_weights():
+    # review regression: the exported policy must reproduce the solution's
+    # behavior, not a random reinitialization
+    problem = VecNE(
+        "pendulum",
+        "Linear(obs_length, act_length)",
+        episode_length=10,
+        seed=7,
+    )
+    batch = problem.generate_batch(3)
+    problem.evaluate(batch)
+    sln = batch[0]
+    module = problem.to_policy(sln)
+    params = module.init(jax.random.key(99))  # arbitrary key: weights are frozen
+    obs = jnp.asarray([0.3, -0.2, 0.5])
+    y_module, _ = module.apply(params, obs)
+    y_callable, _ = problem.to_policy_callable(sln)(obs)
+    assert np.allclose(np.asarray(y_module), np.asarray(y_callable), atol=1e-6)

@@ -1,0 +1,103 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.tools import CBag, CDict, CList, CMemory, do_where
+
+
+def test_do_where_pytree():
+    a = {"x": jnp.ones((3, 2)), "y": jnp.ones(3)}
+    b = {"x": jnp.zeros((3, 2)), "y": jnp.zeros(3)}
+    out = do_where(jnp.array([True, False, True]), a, b)
+    assert np.allclose(np.asarray(out["x"][:, 0]), [1, 0, 1])
+    assert np.allclose(np.asarray(out["y"]), [1, 0, 1])
+
+
+def test_cmemory_masked_ops():
+    m = CMemory.create(4, 2)
+    m = m.set_(1, jnp.array([5.0, 6.0]))
+    assert np.allclose(np.asarray(m[1]), [5.0, 6.0])
+    # masked-out update is a no-op
+    m2 = m.set_(1, jnp.array([9.0, 9.0]), where=jnp.asarray(False))
+    assert np.allclose(np.asarray(m2[1]), [5.0, 6.0])
+    m3 = m.add_(1, jnp.array([1.0, 1.0]))
+    assert np.allclose(np.asarray(m3[1]), [6.0, 7.0])
+    # out-of-range get with default
+    out = m.get(jnp.asarray(10), default=-1.0)
+    assert np.allclose(np.asarray(out), -1.0)
+
+
+def test_cmemory_under_vmap():
+    # a batch of independent memories via vmap
+    memories = CMemory(data=jnp.zeros((5, 3, 2)))  # batch of 5, 3 keys, values (2,)
+    keys = jnp.arange(5) % 3
+    values = jnp.ones((5, 2))
+    updated = jax.vmap(lambda m, k, v: m.set_(k, v))(memories, keys, values)
+    assert float(updated.data[0, 0, 0]) == 1.0
+    assert float(updated.data[1, 1, 0]) == 1.0
+    assert float(updated.data[1, 0, 0]) == 0.0
+
+
+def test_cdict():
+    d = CDict.create(["alpha", "beta"], 3)
+    d = d.set_("alpha", jnp.ones(3))
+    assert np.allclose(np.asarray(d["alpha"]), 1.0)
+    assert np.allclose(np.asarray(d["beta"]), 0.0)
+    with pytest.raises(KeyError):
+        d.get("gamma")
+
+
+def test_clist_push_pop():
+    lst = CList.create(3)
+    lst = lst.append_(1.0).append_(2.0).append_(3.0)
+    assert bool(lst.is_full)
+    # append on full is a masked no-op
+    lst2 = lst.append_(9.0)
+    assert int(lst2.length) == 3
+    lst, v = lst.pop_()
+    assert float(v) == 3.0 and int(lst.length) == 2
+    lst, v = lst.popleft_()
+    assert float(v) == 1.0 and int(lst.length) == 1
+    assert float(lst[0]) == 2.0
+    lst = lst.appendleft_(0.5)
+    assert float(lst[0]) == 0.5
+
+
+def test_clist_negative_index_and_jit():
+    lst = CList.create(4)
+    lst = lst.append_(1.0).append_(2.0)
+    assert float(lst[-1]) == 2.0
+
+    @jax.jit
+    def push_many(lst, values):
+        def step(lst, v):
+            return lst.append_(v), None
+
+        return jax.lax.scan(step, lst, values)[0]
+
+    lst = push_many(CList.create(8), jnp.arange(5.0))
+    assert int(lst.length) == 5
+    assert float(lst[4]) == 4.0
+
+
+def test_cbag():
+    bag = CBag.create(4)
+    bag = bag.push_(2).push_(2).push_(0)
+    assert int(bag.total) == 3
+    bag, k, ok = bag.pop_(2)
+    assert bool(ok) and int(k) == 2
+    bag, k, ok = bag.pop_(jax.random.key(0))
+    assert bool(ok) and int(k) in (0, 2)
+    bag, _, ok = bag.pop_(1)
+    assert not bool(ok)
+
+
+def test_cbag_legacy_prng_key():
+    # review regression: a legacy uint32 PRNGKey must hit the random-pop
+    # branch, not be misread as an element index
+    bag = CBag.create(4).push_(2).push_(2).push_(0)
+    bag2, k, ok = bag.pop_(jax.random.PRNGKey(0))
+    assert np.asarray(k).shape == ()
+    assert bool(ok) and int(k) in (0, 2)
+    assert int(bag2.total) == 2

@@ -1,0 +1,85 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.tools import TensorFrame
+
+
+def make_frame():
+    return TensorFrame.create(
+        fitness=jnp.array([3.0, 1.0, 2.0]),
+        values=jnp.arange(6.0).reshape(3, 2),
+        tag=jnp.array([0, 1, 0]),
+    )
+
+
+def test_create_and_access():
+    f = make_frame()
+    assert len(f) == 3
+    assert f.column_names == ("fitness", "values", "tag")
+    assert np.allclose(np.asarray(f["fitness"]), [3.0, 1.0, 2.0])
+    assert f.values.shape == (3, 2)
+    with pytest.raises(KeyError):
+        f["nope"]
+
+
+def test_scalar_broadcast():
+    f = TensorFrame.create(a=jnp.arange(4.0), b=7.0)
+    assert np.allclose(np.asarray(f["b"]), 7.0)
+    assert len(f) == 4
+
+
+def test_length_mismatch_rejected():
+    with pytest.raises(ValueError):
+        TensorFrame.create(a=jnp.zeros(3), b=jnp.zeros(4))
+
+
+def test_with_and_without_columns():
+    f = make_frame()
+    g = f.with_columns(rank=jnp.array([2, 0, 1]))
+    assert "rank" in g.column_names
+    h = g.without_columns("values")
+    assert "values" not in h.column_names
+    # original untouched
+    assert "rank" not in f.column_names
+
+
+def test_pick_rows():
+    f = make_frame()
+    sub = f.pick[jnp.array([True, False, True])]
+    assert len(sub) == 2
+    assert np.allclose(np.asarray(sub["fitness"]), [3.0, 2.0])
+    sub2 = f.pick[jnp.array([1])]
+    assert float(sub2["fitness"][0]) == 1.0
+    sub3 = f.pick[0:2]
+    assert len(sub3) == 2
+    # frame[mask] routes to pick
+    assert len(f[jnp.array([True, True, False])]) == 2
+
+
+def test_sort_and_concat():
+    f = make_frame()
+    s = f.sort_values("fitness")
+    assert np.asarray(s["fitness"]).tolist() == [1.0, 2.0, 3.0]
+    s = f.sort_values("fitness", descending=True)
+    assert np.asarray(s["fitness"]).tolist() == [3.0, 2.0, 1.0]
+    both = f.concat(f)
+    assert len(both) == 6
+
+
+def test_frame_through_jit():
+    f = make_frame()
+
+    @jax.jit
+    def double_fitness(frame):
+        return frame.with_columns(fitness=frame["fitness"] * 2)
+
+    out = double_fitness(f)
+    assert np.allclose(np.asarray(out["fitness"]), [6.0, 2.0, 4.0])
+
+
+def test_to_pandas():
+    df = make_frame().without_columns("values").to_pandas()
+    assert list(df.columns) == ["fitness", "tag"]
+    assert len(df) == 3

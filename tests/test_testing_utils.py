@@ -1,0 +1,55 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu import testing as T
+
+
+def test_assert_allclose():
+    T.assert_allclose(jnp.ones(3), np.ones(3), atol=1e-8)
+    with pytest.raises(T.TestingError):
+        T.assert_allclose(jnp.ones(3), jnp.zeros(3), atol=0.5)
+    with pytest.raises(ValueError):
+        T.assert_allclose(jnp.ones(3), jnp.ones(3))
+
+
+def test_assert_almost_between():
+    T.assert_almost_between(jnp.array([0.1, 0.9]), 0.0, 1.0)
+    T.assert_almost_between(jnp.array([-0.05]), 0.0, 1.0, atol=0.1)
+    with pytest.raises(T.TestingError):
+        T.assert_almost_between(jnp.array([2.0]), 0.0, 1.0)
+
+
+def test_assert_dtype_matches():
+    T.assert_dtype_matches(jnp.ones(2), "float32")
+    T.assert_dtype_matches(jnp.ones(2), "float")
+    T.assert_dtype_matches(jnp.arange(3), "int")
+    with pytest.raises(T.TestingError):
+        T.assert_dtype_matches(jnp.ones(2), "int")
+
+
+def test_assert_shape_matches():
+    T.assert_shape_matches(jnp.zeros((3, 4)), (3, 4))
+    T.assert_shape_matches(jnp.zeros((3, 4)), (3, "*"))
+    T.assert_shape_matches(jnp.zeros(5), 5)
+    with pytest.raises(T.TestingError):
+        T.assert_shape_matches(jnp.zeros((3, 4)), (4, 3))
+    with pytest.raises(T.TestingError):
+        T.assert_shape_matches(jnp.zeros((3, 4)), (3,))
+
+
+def test_assert_eachclose_and_batch_support():
+    from evotorch_tpu import Problem, vectorized
+
+    T.assert_eachclose(jnp.full((4,), 2.0), 2.0, atol=1e-8)
+    with pytest.raises(T.TestingError):
+        T.assert_eachclose(jnp.array([1.0, 2.0]), 1.0, atol=0.1)
+
+    @vectorized
+    def sphere(xs):
+        return jnp.sum(xs**2, axis=-1)
+
+    p = Problem("min", sphere, solution_length=3, initial_bounds=(-1, 1))
+    batch = p.generate_batch(4)
+    T.assert_shape_matches(batch, (4, 3))
+    T.assert_dtype_matches(batch, "float32")
