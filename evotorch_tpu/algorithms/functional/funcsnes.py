@@ -51,14 +51,19 @@ def snes(
     if (stdev_init is None) == (radius_init is None):
         raise ValueError("Exactly one of stdev_init / radius_init must be provided")
     if radius_init is not None:
-        stdev_init = stdev_from_radius(float(radius_init), n)
+        # radius may be batched (one radius per search lane)
+        stdev_init = jnp.asarray(radius_init, dtype=center_init.dtype) / jnp.sqrt(
+            jnp.asarray(n, dtype=center_init.dtype)
+        )
     if center_learning_rate is None:
         center_learning_rate = 1.0
     if stdev_learning_rate is None:
         stdev_learning_rate = 0.2 * (3 + math.log(n)) / math.sqrt(n)
     return SNESState(
         center=center_init,
-        stdev=jnp.broadcast_to(as_vector_like(stdev_init, center_init, 0.0), center_init.shape),
+        stdev=jnp.broadcast_to(jnp.asarray(stdev_init, dtype=center_init.dtype)[..., None]
+            if jnp.asarray(stdev_init).ndim == center_init.ndim - 1 and jnp.asarray(stdev_init).ndim > 0
+            else as_vector_like(stdev_init, center_init, 0.0), center_init.shape),
         center_learning_rate=jnp.asarray(center_learning_rate, dtype=center_init.dtype),
         stdev_learning_rate=jnp.asarray(stdev_learning_rate, dtype=center_init.dtype),
         ranking_method=str(ranking_method),

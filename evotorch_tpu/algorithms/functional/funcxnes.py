@@ -50,20 +50,31 @@ def xnes(
     if (stdev_init is None) == (radius_init is None):
         raise ValueError("Exactly one of stdev_init / radius_init must be provided")
     if radius_init is not None:
-        stdev_init = stdev_from_radius(float(radius_init), n)
+        # radius may be batched (one radius per search lane)
+        stdev_init = jnp.asarray(radius_init, dtype=center_init.dtype) / jnp.sqrt(
+            jnp.asarray(n, dtype=center_init.dtype)
+        )
     stdev_init = jnp.asarray(stdev_init, dtype=center_init.dtype)
-    # batched center -> batched (eye-scaled) A
-    base = jnp.diag(jnp.broadcast_to(stdev_init, (n,)))
-    A = jnp.broadcast_to(base, center_init.shape[:-1] + (n, n))
+    # batched center -> batched (eye-scaled) A; stdev may be a scalar, a
+    # length-n vector, or a per-lane batch (shape == center batch shape)
+    batch_shape = center_init.shape[:-1]
+    if stdev_init.ndim > 0 and stdev_init.shape == batch_shape:
+        # one stdev per search lane (ambiguous only when num_lanes == n; a
+        # per-dimension stdev then needs an explicit trailing axis)
+        diag = jnp.broadcast_to(stdev_init[..., None], batch_shape + (n,))
+    else:
+        diag = jnp.broadcast_to(stdev_init, batch_shape + (n,))
+    eye = jnp.eye(n, dtype=center_init.dtype)
+    A = eye * diag[..., None, :]
+    A_inv = eye * (1.0 / jnp.maximum(diag, 1e-30))[..., None, :]
     if center_learning_rate is None:
         center_learning_rate = 1.0
     if stdev_learning_rate is None:
         stdev_learning_rate = 0.6 * (3 + math.log(n)) / (n * math.sqrt(n))
-    base_inv = jnp.diag(1.0 / jnp.maximum(jnp.broadcast_to(stdev_init, (n,)), 1e-30))
     return XNESState(
         center=center_init,
         A=A,
-        A_inv=jnp.broadcast_to(base_inv, center_init.shape[:-1] + (n, n)),
+        A_inv=A_inv,
         center_learning_rate=jnp.asarray(center_learning_rate, dtype=center_init.dtype),
         stdev_learning_rate=jnp.asarray(stdev_learning_rate, dtype=center_init.dtype),
         ranking_method=str(ranking_method),

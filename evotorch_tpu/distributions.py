@@ -48,6 +48,57 @@ __all__ = [
     "make_functional_grad_estimator",
 ]
 
+# per-class jitted kernels for the stateful (OO) API: the math lives in pure
+# classmethods, so one compiled executable per (class, static-config) pair
+# serves every instance and every generation
+_JITTED_SAMPLE_CACHE: dict = {}
+_JITTED_GRADS_CACHE: dict = {}
+
+
+def _split_params(parameters: dict):
+    """Separate array parameters from static (string/structural) ones."""
+    static = tuple(
+        sorted(
+            (k, v)
+            for k, v in parameters.items()
+            if isinstance(v, (str, type(None))) or k == "parenthood_ratio"
+        )
+    )
+    arrays = {k: v for k, v in parameters.items() if k not in dict(static)}
+    return arrays, static
+
+
+def _jitted_sample_for(cls):
+    fn = _JITTED_SAMPLE_CACHE.get(cls)
+    if fn is None:
+
+        def sample(key, array_params, static_items, num_solutions):
+            params = dict(array_params)
+            params.update(dict(static_items))
+            return cls._sample(key, params, num_solutions)
+
+        fn = jax.jit(sample, static_argnames=("static_items", "num_solutions"))
+        _JITTED_SAMPLE_CACHE[cls] = fn
+    return fn
+
+
+def _jitted_grads_for(cls):
+    fn = _JITTED_GRADS_CACHE.get(cls)
+    if fn is None:
+
+        def grads(array_params, samples, fitnesses, static_items, ranking_method, higher_is_better):
+            params = dict(array_params)
+            params.update(dict(static_items))
+            weights = rank(fitnesses, ranking_method, higher_is_better=higher_is_better)
+            return cls._compute_gradients(params, samples, weights, ranking_method)
+
+        fn = jax.jit(
+            grads, static_argnames=("static_items", "ranking_method", "higher_is_better")
+        )
+        _JITTED_GRADS_CACHE[cls] = fn
+    return fn
+
+
 class Distribution(TensorMakerMixin, Serializable, RecursivePrintable):
     """Base class for search distributions (reference ``distributions.py:40``)."""
 
@@ -114,7 +165,8 @@ class Distribution(TensorMakerMixin, Serializable, RecursivePrintable):
         internal key state advances (stateful convenience)."""
         if key is None:
             key = self.next_rng_key()
-        return self._sample(key, self._parameters, int(num_solutions))
+        arrays, static = _split_params(self._parameters)
+        return _jitted_sample_for(type(self))(key, arrays, static, int(num_solutions))
 
     @classmethod
     def _sample(cls, key, parameters: dict, num_solutions: int) -> jnp.ndarray:
@@ -133,8 +185,10 @@ class Distribution(TensorMakerMixin, Serializable, RecursivePrintable):
         if objective_sense not in ("min", "max"):
             raise ValueError(f"objective_sense must be 'min' or 'max', got {objective_sense!r}")
         higher_is_better = objective_sense == "max"
-        weights = rank(fitnesses, ranking_method, higher_is_better=higher_is_better)
-        return self._compute_gradients(self._parameters, samples, weights, ranking_method)
+        arrays, static = _split_params(self._parameters)
+        return _jitted_grads_for(type(self))(
+            arrays, jnp.asarray(samples), jnp.asarray(fitnesses), static, ranking_method, higher_is_better
+        )
 
     @classmethod
     def _compute_gradients(cls, parameters: dict, samples, weights, ranking_used) -> dict:

@@ -10,9 +10,10 @@ VMEM scheduling wins:
 - ``fused_centered_rank``: rank -> centered-utility transform fused over a
   fitness vector.
 
-Every kernel has an XLA fallback (used automatically on CPU or when Pallas
-lowering is unavailable), so behavior is identical everywhere; tests exercise
-the kernels in Pallas interpret mode.
+Every kernel has an XLA fallback (the default path), distributionally
+equivalent but not bit-identical (different PRNG streams). CPU tests exercise
+the fused math in Pallas interpret mode; the on-chip-PRNG production kernel
+is covered by a TPU-gated test (tests/test_ops.py::test_pallas_sampling_on_tpu).
 """
 
 from .sampling import sample_symmetric_gaussian

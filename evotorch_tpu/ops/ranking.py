@@ -1,9 +1,11 @@
 """Fused centered-rank utility kernel.
 
 Transforms a fitness vector into centered utilities (``tools/ranking.py``
-semantics) with the rank scatter fused in one kernel. The XLA fallback is the
-library implementation; the Pallas path is a drop-in for very large
-populations where the double-argsort's intermediate tensors matter.
+semantics) with the rank computation fused in one kernel. The XLA fallback is
+the library implementation. The Pallas path materializes an O(n^2) comparison
+block in VMEM, so it targets *mid-sized* populations (n up to ~2000, i.e.
+n^2 * 4 bytes within the ~16 MB VMEM budget); for larger populations use the
+default XLA path, whose argsorts scale O(n log n).
 """
 
 from __future__ import annotations
@@ -49,6 +51,10 @@ def fused_centered_rank(
 
     from jax.experimental import pallas as pl
 
+    if x.shape[-1] == 1:
+        # degenerate population: match the XLA fallback (zeros, no 0/0)
+        return jnp.zeros_like(x)
+
     signed = (x if higher_is_better else -x).astype(jnp.float32)
     batch_shape = signed.shape[:-1]
     flat = signed.reshape((-1, signed.shape[-1]))
@@ -59,4 +65,5 @@ def fused_centered_rank(
         interpret=interpret,
     )
     out = jax.vmap(call)(flat)
-    return out.reshape(batch_shape + (signed.shape[-1],)) if batch_shape else out[0]
+    out = out.reshape(batch_shape + (signed.shape[-1],)) if batch_shape else out[0]
+    return out.astype(x.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else out

@@ -119,6 +119,8 @@ def assert_shape_matches(x: Any, shape: Union[int, Iterable]):
 
 def assert_eachclose(x: Any, value: Any, *, rtol: Optional[float] = None, atol: Optional[float] = None):
     """Assert every element is close to the scalar ``value``
-    (reference ``testing.py:254``)."""
+    (reference ``testing.py:254``). The comparison promotes to float so an
+    integer array is NOT considered close to a fractional target."""
     arr = _to_numpy(x)
-    assert_allclose(arr, np.full_like(arr, value, dtype=arr.dtype), rtol=rtol, atol=atol)
+    expected = np.full(arr.shape, value, dtype=np.result_type(arr.dtype, np.asarray(value).dtype, np.float32))
+    assert_allclose(arr.astype(expected.dtype), expected, rtol=rtol, atol=atol)

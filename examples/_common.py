@@ -1,0 +1,17 @@
+"""Shared example plumbing: platform selection."""
+
+import argparse
+import os
+
+
+def setup_platform():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    parser.add_argument("--generations", type=int, default=None)
+    args, _ = parser.parse_known_args()
+    if args.cpu:
+        os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return args

@@ -1,0 +1,46 @@
+"""Feature-space illumination with MAP-Elites
+(reference Feature_Space_Illumination_with_MAPElites.ipynb).
+
+Fitness: Rastrigin; features: the first two decision variables. The archive
+keeps the best solution per feature cell.
+"""
+
+from _common import setup_platform
+
+args = setup_platform()
+
+import jax.numpy as jnp
+import numpy as np
+
+from evotorch_tpu import Problem, vectorized
+from evotorch_tpu.algorithms import MAPElites
+from evotorch_tpu.operators.real import GaussianMutation
+
+
+@vectorized
+def rastrigin_with_features(x):
+    fitness = 10 * x.shape[-1] + jnp.sum(x**2 - 10 * jnp.cos(2 * jnp.pi * x), axis=-1)
+    features = x[:, :2]
+    return fitness[:, None], features
+
+
+def main():
+    problem = Problem(
+        "min",
+        rastrigin_with_features,
+        solution_length=6,
+        initial_bounds=(-5.12, 5.12),
+        eval_data_length=2,
+        seed=0,
+    )
+    grid = MAPElites.make_feature_grid([-5.12, -5.12], [5.12, 5.12], num_bins=[8, 8])
+    searcher = MAPElites(problem, operators=[GaussianMutation(problem, stdev=0.5)], feature_grid=grid)
+    searcher.run(args.generations or 50)
+    filled = np.asarray(searcher.filled)
+    print(f"archive cells filled: {filled.sum()}/{len(filled)}")
+    best = float(np.nanmin(np.asarray(searcher.population.evals[:, 0])[filled]))
+    print("best fitness in archive:", best)
+
+
+if __name__ == "__main__":
+    main()

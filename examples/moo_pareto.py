@@ -1,0 +1,48 @@
+"""Multi-objective optimization (reference examples/scripts/moo_parallel.py).
+
+The Kursawe function with a GA whose elitist selection is NSGA-II-style
+pareto + crowding. Instead of Ray actors, evaluation can be sharded over the
+device mesh with problem.use_sharded_evaluation().
+"""
+
+from _common import setup_platform
+
+args = setup_platform()
+
+import jax.numpy as jnp
+import numpy as np
+
+from evotorch_tpu import Problem, vectorized
+from evotorch_tpu.algorithms import GeneticAlgorithm
+from evotorch_tpu.operators.real import GaussianMutation, SimulatedBinaryCrossOver
+
+
+@vectorized
+def kursawe(x):
+    f1 = jnp.sum(
+        -10 * jnp.exp(-0.2 * jnp.sqrt(x[:, :-1] ** 2 + x[:, 1:] ** 2)), axis=-1
+    )
+    f2 = jnp.sum(jnp.abs(x) ** 0.8 + 5 * jnp.sin(x**3), axis=-1)
+    return jnp.stack([f1, f2], axis=1)
+
+
+def main():
+    problem = Problem(["min", "min"], kursawe, solution_length=3, initial_bounds=(-5.0, 5.0), seed=0)
+    problem.use_sharded_evaluation()
+    ga = GeneticAlgorithm(
+        problem,
+        operators=[
+            SimulatedBinaryCrossOver(problem, tournament_size=4, eta=8.0),
+            GaussianMutation(problem, stdev=0.03),
+        ],
+        popsize=64,
+    )
+    ga.run(args.generations or 100)
+    fronts = ga.population.arg_pareto_sort()
+    front0 = ga.population.evals[np.asarray(fronts[0])]
+    print(f"pareto front size: {len(fronts[0])}")
+    print("front objective ranges:", np.asarray(front0).min(0), np.asarray(front0).max(0))
+
+
+if __name__ == "__main__":
+    main()

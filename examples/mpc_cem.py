@@ -1,0 +1,69 @@
+"""Model-predictive control with functional CEM
+(reference Model_Predictive_Control_with_CEM notebooks).
+
+At each control step, CEM plans a short action sequence against the known
+(differentiable, jitted) Pendulum dynamics, executes the first action, and
+replans — the whole planner is one jitted function.
+"""
+
+from _common import setup_platform
+
+args = setup_platform()
+
+import jax
+import jax.numpy as jnp
+
+from evotorch_tpu.algorithms.functional import cem, cem_ask, cem_tell
+from evotorch_tpu.envs import Pendulum
+
+HORIZON = 15
+PLAN_ITERS = 8
+POP = 100
+
+
+def main():
+    env = Pendulum()
+
+    def plan_cost(env_state, action_seqs):
+        # action_seqs: (N, HORIZON)
+        def rollout(seq):
+            def step(carry, a):
+                state = carry
+                state, _obs, reward, _done = env.step(state, a[None])
+                return state, reward
+
+            _, rewards = jax.lax.scan(step, env_state, seq)
+            return -jnp.sum(rewards)
+
+        return jax.vmap(rollout)(action_seqs)
+
+    @jax.jit
+    def plan(env_state, key):
+        state = cem(
+            center_init=jnp.zeros(HORIZON),
+            parenthood_ratio=0.2,
+            objective_sense="min",
+            stdev_init=1.0,
+        )
+
+        def iteration(state, key):
+            seqs = cem_ask(key, state, popsize=POP)
+            costs = plan_cost(env_state, jnp.clip(seqs, -2.0, 2.0))
+            return cem_tell(state, seqs, costs), None
+
+        state, _ = jax.lax.scan(iteration, state, jax.random.split(key, PLAN_ITERS))
+        return jnp.clip(state.center[0], -2.0, 2.0)
+
+    key = jax.random.key(0)
+    env_state, obs = env.reset(key)
+    total = 0.0
+    for t in range(args.generations or 100):
+        key, sub = jax.random.split(key)
+        action = plan(env_state, sub)
+        env_state, obs, reward, done = env.step(env_state, action[None])
+        total += float(reward)
+    print("total reward over horizon:", round(total, 2))
+
+
+if __name__ == "__main__":
+    main()

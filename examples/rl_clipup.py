@@ -1,0 +1,46 @@
+"""PGPE + ClipUp on vectorized RL (reference examples/scripts/rl_clipup.py).
+
+The Toklu et al. (2020) configuration style: PGPE with 0-centered ranking and
+ClipUp, evaluated on a fully-jitted vectorized environment. The reference
+fans evaluation out over Ray CPU actors; here one SPMD program rolls out the
+whole population on device.
+"""
+
+from _common import setup_platform
+
+args = setup_platform()
+
+from evotorch_tpu.algorithms import PGPE
+from evotorch_tpu.logging import PandasLogger, StdOutLogger
+from evotorch_tpu.neuroevolution import VecNE
+
+
+def main():
+    problem = VecNE(
+        "cartpole",
+        "Linear(obs_length, act_length)",
+        env_config={"continuous_actions": False},
+        seed=42,
+    )
+    searcher = PGPE(
+        problem,
+        popsize=200,
+        center_learning_rate=0.5,
+        stdev_learning_rate=0.1,
+        stdev_init=0.5,
+        optimizer="clipup",
+        optimizer_config={"max_speed": 1.0},
+        ranking_method="centered",
+    )
+    StdOutLogger(searcher, interval=5)
+    pandas_logger = PandasLogger(searcher)
+    searcher.run(args.generations or 30)
+
+    center = searcher.status["center"]
+    problem.save_solution(center, "rl_clipup_solution.pkl")
+    print(pandas_logger.to_dataframe()[["mean_eval", "pop_best_eval"]].tail())
+    print("saved solution to rl_clipup_solution.pkl")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Run a saved RL solution (reference examples/scripts/rl_enjoy.py)."""
+
+import argparse
+import pickle
+
+from _common import setup_platform
+
+args = setup_platform()
+_parser = argparse.ArgumentParser()
+_parser.add_argument("--solution", default="rl_clipup_solution.pkl")
+_extra, _ = _parser.parse_known_args()
+
+import jax.numpy as jnp
+
+from evotorch_tpu.neuroevolution import VecNE
+
+
+def main():
+    fname = _extra.solution
+    with open(fname, "rb") as f:
+        payload = pickle.load(f)
+    problem = VecNE(
+        "cartpole",
+        "Linear(obs_length, act_length)",
+        env_config={"continuous_actions": False},
+    )
+    batch = problem.generate_batch(1)
+    batch.set_values(jnp.asarray(payload["values"])[None, :])
+    problem.evaluate(batch)
+    print("episodic return:", float(batch.evals[0, 0]))
+
+
+if __name__ == "__main__":
+    main()

@@ -179,3 +179,14 @@ def test_batched_xnes_and_snes():
             ask=ask, tell=tell, fitness=fitness, popsize=16, num_generations=120,
         )
         assert np.allclose(np.asarray(state.center), np.asarray(targets), atol=0.5)
+
+
+def test_batched_radius_init():
+    from evotorch_tpu.algorithms.functional import snes, xnes
+
+    s = snes(center_init=jnp.ones((2, 4)), objective_sense="min", radius_init=jnp.array([1.0, 2.0]))
+    assert s.stdev.shape == (2, 4)
+    assert np.allclose(np.asarray(s.stdev[:, 0]), [0.5, 1.0])
+    x = xnes(center_init=jnp.ones((2, 4)), objective_sense="min", radius_init=jnp.array([1.0, 2.0]))
+    assert x.A.shape == (2, 4, 4)
+    assert np.allclose(np.asarray(x.A[1, 0, 0]), 1.0)

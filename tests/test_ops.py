@@ -78,3 +78,26 @@ def test_fused_centered_rank_batched_pallas():
     expected = np.asarray(centered(fit, higher_is_better=True))
     assert got.shape == (3, 32)
     assert np.allclose(got, expected, atol=1e-6)
+
+
+def test_pallas_sampling_on_tpu():
+    # exercises the REAL on-chip-PRNG kernel; only runs on TPU hardware
+    if jax.default_backend() not in ("tpu",):
+        pytest.skip("real pallas kernel requires TPU hardware")
+    mu = jnp.zeros(128)
+    sigma = jnp.ones(128)
+    out = sample_symmetric_gaussian(jax.random.key(0), mu, sigma, 256, use_pallas=True)
+    vals = np.asarray(out)
+    assert np.allclose(vals[0::2] + vals[1::2], 0.0, atol=1e-5)
+    assert abs(vals.mean()) < 0.05
+    assert abs(vals.std() - 1.0) < 0.05
+
+
+def test_fused_centered_rank_degenerate_and_dtype():
+    # review regression: n == 1 must match the XLA fallback (no NaN)
+    out = fused_centered_rank(jnp.array([5.0]), use_pallas=True, interpret=True)
+    assert float(out[0]) == 0.0
+    f32 = fused_centered_rank(
+        jnp.arange(4, dtype=jnp.float32), use_pallas=True, interpret=True
+    )
+    assert f32.dtype == jnp.float32

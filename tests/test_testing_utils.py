@@ -53,3 +53,9 @@ def test_assert_eachclose_and_batch_support():
     batch = p.generate_batch(4)
     T.assert_shape_matches(batch, (4, 3))
     T.assert_dtype_matches(batch, "float32")
+
+
+def test_assert_eachclose_integer_truncation():
+    # review regression: integer arrays must not pass against fractional targets
+    with pytest.raises(T.TestingError):
+        T.assert_eachclose(jnp.array([2, 2]), 2.9, atol=0.1)
