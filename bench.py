@@ -57,6 +57,9 @@ def main():
     popsize = int(os.environ.get("BENCH_POPSIZE", 10_000))
     episode_length = int(os.environ.get("BENCH_EPISODE_LENGTH", 200))
     generations = int(os.environ.get("BENCH_GENERATIONS", 3))
+    # opt-in: bf16 changes the measured compute dtype, so keep the default
+    # comparable with previously recorded f32 baselines
+    compute_dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
 
     env = Swimmer2D(n_links=6)
     net = (
@@ -69,7 +72,7 @@ def main():
     policy = FlatParamsPolicy(net)
     print(
         f"devices={jax.devices()} popsize={popsize} params={policy.parameter_count} "
-        f"episode_length={episode_length}",
+        f"episode_length={episode_length} compute_dtype={compute_dtype or 'float32'}",
         file=sys.stderr,
     )
 
@@ -93,6 +96,7 @@ def main():
             stats,
             num_episodes=1,
             episode_length=episode_length,
+            compute_dtype=compute_dtype,
         )
         state = pgpe_tell(state, values, result.scores)
         return state, result.total_steps, result.scores

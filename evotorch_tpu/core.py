@@ -336,11 +336,67 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         """Single-program evaluation (reference ``core.py:2573``). When a
         sharded evaluator has been installed (``use_sharded_evaluation``),
         the population axis is sharded over the mesh instead."""
+        self._resolve_num_actors_request()
         if self._sharded_evaluator is not None:
-            evals = self._sharded_evaluator(batch.values)
+            try:
+                evals = self._sharded_evaluator(batch.values)
+            except Exception as e:  # noqa: BLE001 — graceful degradation
+                # the objective turned out not to be jax-traceable (the
+                # reference runs arbitrary Python in actors; we cannot) —
+                # fall back to eager evaluation instead of crashing
+                from .tools.misc import set_default_logger_config
+
+                set_default_logger_config().warning(
+                    "sharded evaluation failed (%s: %s); falling back to "
+                    "single-program eager evaluation",
+                    type(e).__name__,
+                    e,
+                )
+                self._sharded_evaluator = None
+                self._evaluate_batch(batch)
+                return
             batch.set_evals(*self._split_eval_outputs(evals))
             return
         self._evaluate_batch(batch)
+
+    def _resolve_num_actors_request(self):
+        """Drop-in parity for ``num_actors`` (reference ``core.py:1302-1595``):
+        a request for N actors becomes a request for an N-device (or
+        all-device, for "max"/"num_devices"/"num_gpus") mesh over which the
+        population axis is sharded. Resolved lazily at first evaluation, like
+        the reference's lazy ``_parallelize``."""
+        if self._num_actors_requested is None or self._sharded_evaluator is not None:
+            return
+        request = self._num_actors_requested
+        self._num_actors_requested = None  # resolve once
+        if not self._vectorized or self._objective_func is None:
+            # no jax-pure batched objective to shard; warn instead of a
+            # silent no-op (subclasses like VecNE honor the request themselves)
+            from .tools.misc import set_default_logger_config
+
+            set_default_logger_config().warning(
+                "num_actors=%r has no effect for this problem type: sharded "
+                "evaluation needs a @vectorized objective function (or a "
+                "problem class with its own sharded path, e.g. VecNE)",
+                request,
+            )
+            return
+        import jax
+
+        if isinstance(request, str):
+            if request in ("max", "num_devices", "num_gpus", "num_cpus"):
+                n = jax.device_count()
+            else:
+                raise ValueError(f"Unrecognized num_actors request: {request!r}")
+        else:
+            n = min(int(request), jax.device_count())
+        if n <= 1:
+            return
+        from .parallel import make_sharded_evaluator
+        from .parallel.mesh import default_mesh
+
+        mesh = default_mesh(("pop",), devices=jax.devices()[:n])
+        self._sharded_evaluator = make_sharded_evaluator(self._objective_func, mesh=mesh)
 
     def _evaluate_batch(self, batch: "SolutionBatch"):
         """Vectorized objective call or per-solution loop

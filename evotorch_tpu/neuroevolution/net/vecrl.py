@@ -145,6 +145,7 @@ def _policy_to_action(raw, action_space, noise, clip: bool):
         "alive_bonus_schedule",
         "decrease_rewards_by",
         "action_noise_stdev",
+        "compute_dtype",
     ),
 )
 def run_vectorized_rollout(
@@ -160,6 +161,7 @@ def run_vectorized_rollout(
     alive_bonus_schedule: Optional[tuple] = None,
     decrease_rewards_by: Optional[float] = None,
     action_noise_stdev: Optional[float] = None,
+    compute_dtype=None,
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
 
@@ -168,8 +170,15 @@ def run_vectorized_rollout(
     stepping with an activity mask, auto-reset until each env has finished
     ``num_episodes`` episodes, masked running-norm updates, alive-bonus and
     reward adjustments — but compiled into a single ``lax.while_loop``.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) casts the policy parameters and
+    its inputs for the forward pass — the MXU fast path; ES is robust to
+    low-precision fitness since ranking is scale-free. Env dynamics, rewards
+    and statistics stay in f32.
     """
     n = params_batch.shape[0]
+    if compute_dtype is not None:
+        params_batch = params_batch.astype(compute_dtype)
     max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
     if episode_length is not None:
         max_t = min(max_t, int(episode_length))
@@ -183,8 +192,13 @@ def run_vectorized_rollout(
     if policy_proto is None:
         policy_states = None
     else:
+        state_dtype = compute_dtype  # recurrent state lives in compute dtype
         policy_states = jax.tree_util.tree_map(
-            lambda leaf: jnp.broadcast_to(leaf, (n,) + leaf.shape), policy_proto
+            lambda leaf: jnp.broadcast_to(
+                leaf if state_dtype is None else leaf.astype(state_dtype),
+                (n,) + leaf.shape,
+            ),
+            policy_proto,
         )
 
     class Carry(NamedTuple):
@@ -223,12 +237,16 @@ def run_vectorized_rollout(
         policy_in = (
             stats_normalize(c.stats, c.obs) if observation_normalization else c.obs
         )
+        if compute_dtype is not None:
+            policy_in = policy_in.astype(compute_dtype)
         if c.policy_states is None:
             raw, new_policy_states = jax.vmap(lambda p, o: policy(p, o))(
                 params_batch, policy_in
             )
         else:
             raw, new_policy_states = jax.vmap(policy)(params_batch, policy_in, c.policy_states)
+        if compute_dtype is not None:
+            raw = raw.astype(jnp.float32)
 
         noise = None
         if action_noise_stdev is not None:

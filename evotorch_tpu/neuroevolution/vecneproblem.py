@@ -50,6 +50,7 @@ class VecNE(NEProblem):
         action_noise_stdev: Optional[float] = None,
         num_episodes: int = 1,
         episode_length: Optional[int] = None,
+        compute_dtype=None,
         initial_bounds=(-0.00001, 0.00001),
         seed: Optional[int] = None,
         num_actors=None,
@@ -68,6 +69,8 @@ class VecNE(NEProblem):
         self._num_episodes = int(num_episodes)
         self._episode_length = None if episode_length is None else int(episode_length)
         self._max_num_envs = None if max_num_envs is None else int(max_num_envs)
+        # bfloat16 (etc.) policy compute for the MXU fast path
+        self._compute_dtype = compute_dtype
 
         self._obs_norm = RunningNorm(self._env.observation_size)
         self._interaction_count = 0
@@ -127,10 +130,39 @@ class VecNE(NEProblem):
             alive_bonus_schedule=self._alive_bonus_schedule,
             decrease_rewards_by=self._decrease_rewards_by,
             action_noise_stdev=self._action_noise_stdev,
+            compute_dtype=self._compute_dtype,
         )
         return result
 
+    def _resolve_num_actors_request(self):
+        """VecNE honors ``num_actors`` through its own sharded path (the
+        generic resolver would warn: there is no plain objective_func)."""
+
+    def _num_actors_mesh(self, popsize: int):
+        """Mesh for a pending ``num_actors`` request, sized to the largest
+        shard count <= the request that divides the population size."""
+        request = self._num_actors_requested
+        if request is None:
+            return None
+        if isinstance(request, str):
+            if request in ("max", "num_devices", "num_gpus", "num_cpus"):
+                n = jax.device_count()
+            else:
+                raise ValueError(f"Unrecognized num_actors request: {request!r}")
+        else:
+            n = min(int(request), jax.device_count())
+        n = max(1, n)
+        while popsize % n != 0:
+            n -= 1
+        if n <= 1:
+            return None
+        return default_mesh(("pop",), devices=jax.devices()[:n])
+
     def _evaluate_batch(self, batch: SolutionBatch):
+        mesh = self._num_actors_mesh(len(batch))
+        if mesh is not None:
+            self.evaluate_sharded(batch, mesh=mesh)
+            return
         values = jnp.asarray(batch.values)
         n = values.shape[0]
         if self._max_num_envs is not None and n > self._max_num_envs:
@@ -239,6 +271,7 @@ class VecNE(NEProblem):
                 alive_bonus_schedule=self._alive_bonus_schedule,
                 decrease_rewards_by=self._decrease_rewards_by,
                 action_noise_stdev=self._action_noise_stdev,
+                compute_dtype=self._compute_dtype,
             )
             # merge the per-shard stat deltas with a psum
             delta = jax.tree_util.tree_map(lambda new, old: new - old, result.stats, stats)

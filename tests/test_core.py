@@ -343,3 +343,44 @@ def test_getitem_with_zero_d_index():
     # 1-d index arrays still produce sub-batches
     sub = batch[jnp.array([0, 2])]
     assert isinstance(sub, SolutionBatch) and len(sub) == 2
+
+
+def test_num_actors_triggers_sharded_evaluation():
+    # drop-in parity: num_actors requests become mesh sharding
+    p = Problem("min", sphere, solution_length=4, initial_bounds=(-1, 1), num_actors=4)
+    batch = p.generate_batch(16)
+    p.evaluate(batch)
+    assert batch.is_evaluated
+    assert p._sharded_evaluator is not None
+
+    p2 = Problem("min", sphere, solution_length=4, initial_bounds=(-1, 1), num_actors="max")
+    p2.evaluate(p2.generate_batch(8))
+    assert p2._sharded_evaluator is not None
+
+    # per-solution problems silently stay host-side (no actor pool exists)
+    p3 = Problem("min", lambda row: jnp.sum(row**2), solution_length=3,
+                 initial_bounds=(-1, 1), num_actors=4)
+    p3.evaluate(p3.generate_batch(4))
+    assert p3._sharded_evaluator is None
+
+
+def test_non_traceable_objective_falls_back(caplog):
+    # review regression: a host-side (non-jax) vectorized objective with
+    # num_actors must degrade gracefully, not crash in tracing
+    import numpy as onp
+
+    @vectorized
+    def host_objective(xs):
+        return jnp.asarray(onp.sum(onp.asarray(xs) ** 2, axis=-1))
+
+    p = Problem("min", host_objective, solution_length=3, initial_bounds=(-1, 1), num_actors=4)
+    batch = p.generate_batch(8)
+    p.evaluate(batch)
+    assert batch.is_evaluated
+    assert p._sharded_evaluator is None  # fell back
+
+
+def test_num_actors_single_device_noop():
+    p = Problem("min", sphere, solution_length=3, initial_bounds=(-1, 1), num_actors=1)
+    p.evaluate(p.generate_batch(4))
+    assert p._sharded_evaluator is None

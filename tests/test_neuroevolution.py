@@ -261,3 +261,22 @@ def test_to_policy_carries_evolved_weights():
     y_module, _ = module.apply(params, obs)
     y_callable, _ = problem.to_policy_callable(sln)(obs)
     assert np.allclose(np.asarray(y_module), np.asarray(y_callable), atol=1e-6)
+
+
+def test_vecne_num_actors_uses_sharded_path():
+    # review regression: num_actors must not be silently ignored by VecNE
+    problem = VecNE(
+        "pendulum",
+        "Linear(obs_length, act_length)",
+        episode_length=10,
+        num_actors="max",
+        seed=9,
+    )
+    batch = problem.generate_batch(16)
+    problem.evaluate(batch)
+    assert batch.is_evaluated
+    assert problem.status["total_interaction_count"] == 160
+    # popsize not divisible by any shard count > 1 falls back to local
+    batch2 = problem.generate_batch(7)
+    problem.evaluate(batch2)
+    assert batch2.is_evaluated

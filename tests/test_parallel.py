@@ -109,3 +109,11 @@ def test_sharded_grad_estimator_converges():
 
     mu = run(mu, jax.random.key(1))
     assert float(jnp.linalg.norm(mu)) < 1.0
+
+
+def test_dryrun_multichip_various_topologies():
+    import __graft_entry__ as g
+
+    # even and odd device counts; both must compile + execute
+    g.dryrun_multichip(2)
+    g.dryrun_multichip(3)

@@ -158,3 +158,35 @@ def test_rollout_recurrent_policy():
         env, policy, params, jax.random.key(1), stats, num_episodes=1, episode_length=25
     )
     assert result.scores.shape == (3,)
+
+
+def test_rollout_bf16_compute():
+    env = Pendulum()
+    net = Linear(env.observation_size, env.action_size) >> Tanh()
+    policy = FlatParamsPolicy(net)
+    params = jax.vmap(policy.init_parameters)(jax.random.split(jax.random.key(0), 4))
+    stats = RunningNorm(env.observation_size).stats
+    r32 = run_vectorized_rollout(
+        env, policy, params, jax.random.key(1), stats, num_episodes=1, episode_length=20
+    )
+    rbf = run_vectorized_rollout(
+        env, policy, params, jax.random.key(1), stats, num_episodes=1, episode_length=20,
+        compute_dtype=jnp.bfloat16,
+    )
+    assert rbf.scores.dtype == jnp.float32
+    # bf16 forward changes actions slightly but scores stay in the same regime
+    assert np.allclose(np.asarray(rbf.scores), np.asarray(r32.scores), rtol=0.3, atol=30.0)
+
+
+def test_rollout_bf16_recurrent():
+    env = Pendulum()
+    net = RNN(env.observation_size, 8) >> Linear(8, env.action_size)
+    policy = FlatParamsPolicy(net)
+    params = jax.vmap(policy.init_parameters)(jax.random.split(jax.random.key(2), 3))
+    stats = RunningNorm(env.observation_size).stats
+    result = run_vectorized_rollout(
+        env, policy, params, jax.random.key(3), stats, num_episodes=1, episode_length=15,
+        compute_dtype=jnp.bfloat16,
+    )
+    assert result.scores.shape == (3,)
+    assert np.isfinite(np.asarray(result.scores)).all()
