@@ -8,6 +8,7 @@ and batchable (extra leftmost dims on states/hyperparams = batched searches).
 from .funcadam import AdamState, adam, adam_ask, adam_tell
 from .funcclipup import ClipUpState, clipup, clipup_ask, clipup_tell
 from .funccem import CEMState, cem, cem_ask, cem_tell
+from .funcga import GAState, default_variation, ga, ga_ask, ga_tell
 from .funccmaes import CMAESState, cmaes, cmaes_ask, cmaes_tell
 from .funcpgpe import PGPEState, pgpe, pgpe_ask, pgpe_tell
 from .funcsnes import SNESState, snes, snes_ask, snes_tell
@@ -28,6 +29,11 @@ __all__ = [
     "cem",
     "cem_ask",
     "cem_tell",
+    "GAState",
+    "ga",
+    "ga_ask",
+    "ga_tell",
+    "default_variation",
     "CMAESState",
     "cmaes",
     "cmaes_ask",

@@ -8,8 +8,6 @@ batch the whole optimizer (nested searches), matching the reference's
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax.numpy as jnp
 
 from ...decorators import expects_ndim

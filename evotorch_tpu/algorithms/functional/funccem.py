@@ -10,7 +10,6 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
-import jax
 import jax.numpy as jnp
 
 from ...decorators import expects_ndim

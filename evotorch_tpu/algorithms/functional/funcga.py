@@ -1,0 +1,170 @@
+"""Functional genetic algorithm: ``ga`` / ``ga_ask`` / ``ga_tell``.
+
+The reference ships GA building blocks functionally
+(``operators/functional.py``: tournament, crossover, mutation, ``combine``,
+``take_best``) but no assembled ask/tell loop; this module provides one, so a
+full (elitist) GA — including NSGA-II-style multi-objective selection —
+compiles into a single ``lax.scan``. Single- and multi-objective, with a
+user-pluggable variation pipeline.
+
+Usage::
+
+    values = ...                          # (popsize, L) initial population
+    state = ga(values_init=values, evals_init=f(values), objective_sense="min")
+    def gen(state, key):
+        children = ga_ask(key, state)     # children only — parent evals are
+        state = ga_tell(state, children, f(children))  # reused, not recomputed
+        return state, None
+    state, _ = jax.lax.scan(gen, state, jax.random.split(key, n_generations))
+
+The caller evaluates the initial population once before the loop; from then
+on each generation costs exactly one ``popsize``-sized evaluation (the OO
+``GeneticAlgorithm``'s ``re_evaluate=False`` economy).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...operators import functional as F
+from ...tools.pytree import pytree_dataclass, replace, static_field
+
+__all__ = ["GAState", "ga", "ga_ask", "ga_tell", "default_variation"]
+
+
+@pytree_dataclass
+class GAState:
+    values: jnp.ndarray  # (popsize, L) current evaluated population
+    evals: jnp.ndarray  # (popsize,) or (popsize, n_obj)
+    popsize: int = static_field()
+    objective_sense: Union[str, tuple] = static_field()
+    elitist: bool = static_field()
+
+
+def default_variation(
+    *,
+    tournament_size: int = 4,
+    num_points: Optional[int] = None,
+    eta: Optional[float] = None,
+    mutation_stdev: Optional[float] = 0.1,
+    mutation_probability: Optional[float] = None,
+) -> Callable:
+    """Standard pipeline: tournament parent selection, then k-point crossover
+    (``num_points``, default 1) or SBX (``eta``) — mutually exclusive — plus
+    optional Gaussian mutation."""
+    if num_points is not None and eta is not None:
+        raise ValueError(
+            "Provide either num_points (k-point crossover) or eta (SBX), not both"
+        )
+    if num_points is None and eta is None:
+        num_points = 1
+
+    def variation(key, values, evals, objective_sense, num_children):
+        k1, k2 = jax.random.split(key)
+        if eta is not None:
+            children = F.simulated_binary_cross_over(
+                k1, values, evals, eta=eta,
+                tournament_size=tournament_size, num_children=num_children,
+                objective_sense=objective_sense,
+            )
+        else:
+            children = F.multi_point_cross_over(
+                k1, values, evals, num_points=num_points,
+                tournament_size=tournament_size, num_children=num_children,
+                objective_sense=objective_sense,
+            )
+        if mutation_stdev is not None:
+            children = F.gaussian_mutation(
+                k2, children, stdev=mutation_stdev,
+                mutation_probability=mutation_probability,
+            )
+        return children
+
+    return variation
+
+
+def ga(
+    *,
+    values_init: jnp.ndarray,
+    evals_init: jnp.ndarray,
+    objective_sense: Union[str, Sequence[str]],
+    elitist: bool = True,
+) -> GAState:
+    """Initial GA state from an **evaluated** initial population (evaluate it
+    once with your fitness function before calling this)."""
+    values_init = jnp.asarray(values_init)
+    evals_init = jnp.asarray(evals_init)
+    if values_init.ndim != 2:
+        raise ValueError(f"values_init must be (popsize, L); got {values_init.shape}")
+    if evals_init.shape[0] != values_init.shape[0]:
+        raise ValueError(
+            f"evals_init has {evals_init.shape[0]} rows for {values_init.shape[0]} solutions"
+        )
+    sense = objective_sense if isinstance(objective_sense, str) else tuple(objective_sense)
+    n_obj = 1 if isinstance(sense, str) else len(sense)
+    if n_obj > 1 and (evals_init.ndim != 2 or evals_init.shape[1] != n_obj):
+        raise ValueError(
+            f"evals_init must be (popsize, {n_obj}) for {n_obj} objectives; got {evals_init.shape}"
+        )
+    return GAState(
+        values=values_init,
+        evals=evals_init,
+        popsize=int(values_init.shape[0]),
+        objective_sense=sense,
+        elitist=bool(elitist),
+    )
+
+
+def ga_ask(
+    key,
+    state: GAState,
+    *,
+    variation: Optional[Callable] = None,
+    num_children: Optional[int] = None,
+) -> jnp.ndarray:
+    """Produce children from the current (evaluated) population via the
+    variation pipeline. Only the children need evaluating — the parents'
+    fitnesses are already in the state."""
+    variation = variation if variation is not None else default_variation()
+    sense = state.objective_sense
+    sense_arg = sense if isinstance(sense, str) else list(sense)
+    n = int(num_children) if num_children is not None else state.popsize
+    if n % 2 != 0:
+        raise ValueError(f"num_children must be even, got {n}")
+    return variation(key, state.values, state.evals, sense_arg, n)
+
+
+def ga_tell(state: GAState, child_values, child_evals) -> GAState:
+    """Select the next population. Elitist: ``take_best`` over
+    parents + children (NSGA-II pareto + crowding for multiple objectives);
+    non-elitist: children replace parents (topped up with the best parents
+    when there are fewer children than popsize)."""
+    child_values = jnp.asarray(child_values)
+    child_evals = jnp.asarray(child_evals)
+    sense = state.objective_sense
+    sense_arg = sense if isinstance(sense, str) else list(sense)
+    if state.elitist:
+        all_values, all_evals = F.combine(
+            (state.values, state.evals), (child_values, child_evals),
+            objective_sense=sense_arg,
+        )
+        best_values, best_evals = F.take_best(
+            all_values, all_evals, state.popsize, objective_sense=sense_arg
+        )
+    elif child_values.shape[0] >= state.popsize:
+        best_values, best_evals = F.take_best(
+            child_values, child_evals, state.popsize, objective_sense=sense_arg
+        )
+    else:
+        deficit = state.popsize - child_values.shape[0]
+        top_values, top_evals = F.take_best(
+            state.values, state.evals, deficit, objective_sense=sense_arg
+        )
+        best_values, best_evals = F.combine(
+            (top_values, top_evals), (child_values, child_evals),
+            objective_sense=sense_arg,
+        )
+    return replace(state, values=best_values, evals=best_evals)

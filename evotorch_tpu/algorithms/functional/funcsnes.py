@@ -15,7 +15,6 @@ from typing import Optional, Union
 import jax.numpy as jnp
 
 from ...distributions import ExpSeparableGaussian, make_functional_grad_estimator
-from ...tools.misc import stdev_from_radius
 from ...tools.pytree import pytree_dataclass, replace, static_field
 from .misc import as_vector_like
 

@@ -15,7 +15,6 @@ import jax.numpy as jnp
 
 from ...decorators import expects_ndim
 from ...distributions import ExpGaussian
-from ...tools.misc import stdev_from_radius
 from ...tools.pytree import pytree_dataclass, replace, static_field
 from ...tools.ranking import rank
 

@@ -23,7 +23,6 @@ import math
 from copy import deepcopy
 from typing import Optional
 
-import jax.numpy as jnp
 import numpy as np
 
 from ..core import Problem, SolutionBatch

@@ -9,11 +9,11 @@ Parity: reference ``algorithms/searchalgorithm.py`` — ``LazyReporter``
 from __future__ import annotations
 
 from datetime import datetime
-from typing import Any, Callable, Optional
+from typing import Optional
 
 import numpy as np
 
-from ..core import Problem, SolutionBatch
+from ..core import Problem
 from ..tools.hook import Hook
 
 __all__ = [

@@ -26,12 +26,11 @@ batched pure-functional API.
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Optional, Type
+from typing import Callable, Optional, Type
 
 import jax
 import jax.numpy as jnp
 
-from .decorators import expects_ndim
 from .tools.cloning import Serializable
 from .tools.misc import to_jax_dtype
 from .tools.ranking import rank

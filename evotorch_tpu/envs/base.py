@@ -6,7 +6,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 
-from ..tools.pytree import pytree_dataclass, static_field
+from ..tools.pytree import pytree_dataclass
 
 __all__ = ["Space", "EnvState", "Env"]
 

@@ -15,7 +15,6 @@ from ..neuroevolution.net.layers import (
     Module,
     Sequential,
     StructuredControlNet,
-    Tanh,
 )
 
 __all__ = [

@@ -23,7 +23,6 @@ from typing import Callable, Optional, Union
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import SolutionBatch
 from .neproblem import NEProblem
 from .net.layers import Module
 from .net.rl import alive_bonus_for_step, reset_env, take_step_in_env

@@ -14,7 +14,7 @@ template and is jit/vmap-transparent.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -2,8 +2,6 @@
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from .functional import count_parameters, fill_parameters, parameter_vector
 
 __all__ = ["count_parameters", "fill_parameters", "parameter_vector", "device_of_module"]

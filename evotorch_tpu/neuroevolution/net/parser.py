@@ -13,10 +13,8 @@ from __future__ import annotations
 import ast
 from typing import Any, Dict
 
-import jax.numpy as jnp
-
 from . import layers as _layers
-from .layers import Module, Sequential
+from .layers import Module
 
 __all__ = ["str_to_net", "NetParsingError"]
 

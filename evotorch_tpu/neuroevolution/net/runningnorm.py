@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...tools.pytree import pytree_dataclass, replace
+from ...tools.pytree import pytree_dataclass
 
 __all__ = ["CollectedStats", "RunningNorm", "RunningStat"]
 

@@ -14,12 +14,11 @@ single ``lax.while_loop`` (SURVEY.md §3.4 and §5 long-context note).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
-from ...tools.pytree import pytree_dataclass, replace
 from ..net.functional import FlatParamsPolicy
 from ..net.rl import alive_bonus_for_step
 from ..net.runningnorm import CollectedStats, stats_normalize, stats_update

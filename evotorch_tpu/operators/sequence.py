@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core import Problem, SolutionBatch
+from ..core import SolutionBatch
 from ..tools.objectarray import ObjectArray
 from .base import CrossOver
 

@@ -12,9 +12,8 @@ plays the role of the reference's generic ``TorchOptimizer``
 from __future__ import annotations
 
 from collections.abc import Mapping
-from typing import Callable, Optional, Union
+from typing import Callable, Optional
 
-import jax
 import jax.numpy as jnp
 
 from .tools.misc import ensure_array_length_and_dtype, to_jax_dtype

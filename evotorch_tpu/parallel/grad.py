@@ -16,7 +16,6 @@ from __future__ import annotations
 from typing import Callable, Optional, Type
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..tools.ranking import rank

@@ -8,7 +8,7 @@ Ray actors", you ask "which mesh axes". The default is a 1-D mesh named
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Union
+from typing import Sequence
 
 import jax
 import numpy as np

@@ -7,7 +7,7 @@ status-merging machinery, ``searchalgorithm.py:380-397``).
 from __future__ import annotations
 
 from collections.abc import MutableSequence
-from typing import Any, Callable, Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 __all__ = ["Hook"]
 

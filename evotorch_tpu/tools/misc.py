@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import math
 from numbers import Number
-from typing import Any, Iterable, List, Optional, Union
+from typing import Any, List, Optional, Union
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,7 @@ storage discipline as the reference: values are stored as immutable clones
 from __future__ import annotations
 
 from collections.abc import Sequence
-from typing import Any, Iterable, Optional
+from typing import Iterable, Optional
 
 import numpy as np
 

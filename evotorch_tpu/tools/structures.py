@@ -16,7 +16,7 @@ than explicit batch shapes.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Union
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -8,9 +8,8 @@ pytree dataclass of named equal-length columns, so whole frames pass through
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Optional, Union
+from typing import Any, Dict, Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

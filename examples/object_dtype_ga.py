@@ -1,0 +1,77 @@
+"""Variable-length (object-dtype) evolution
+(reference Evolving_Objects.ipynb / Genetic_Programming.ipynb territory).
+
+Solutions are integer sequences of varying length; fitness rewards sequences
+that sum close to a target while staying short. Object-dtype populations live
+host-side (SURVEY.md §7): this path exists for problems that cannot be
+expressed as fixed-shape arrays.
+"""
+
+from _common import setup_platform
+
+args = setup_platform()
+
+import numpy as np
+
+from evotorch_tpu import Problem
+from evotorch_tpu.algorithms import GeneticAlgorithm
+from evotorch_tpu.operators.base import CopyingOperator
+from evotorch_tpu.operators.sequence import CutAndSplice
+from evotorch_tpu.core import SolutionBatch
+from evotorch_tpu.tools import ObjectArray
+
+TARGET = 42
+
+
+class SequenceProblem(Problem):
+    def __init__(self):
+        super().__init__("max", dtype=object, seed=0)
+        self._rng = np.random.default_rng(0)
+
+    def _fill(self, n, key):
+        arr = ObjectArray(n)
+        for i in range(n):
+            length = int(self._rng.integers(1, 8))
+            arr[i] = [int(v) for v in self._rng.integers(0, 10, size=length)]
+        return arr
+
+    def _evaluate(self, solution):
+        seq = list(solution.values)
+        fitness = -abs(sum(seq) - TARGET) - 0.1 * len(seq)
+        solution.set_evals(float(fitness))
+
+
+class SequenceMutation(CopyingOperator):
+    def __init__(self, problem):
+        super().__init__(problem)
+        self._rng = np.random.default_rng(1)
+
+    def _do(self, batch):
+        result = SolutionBatch(self._problem, len(batch), empty=True)
+        for i in range(len(batch)):
+            seq = list(batch[i].values)
+            roll = self._rng.random()
+            if roll < 0.3 and len(seq) > 1:
+                seq.pop(int(self._rng.integers(len(seq))))
+            elif roll < 0.6:
+                seq.insert(int(self._rng.integers(len(seq) + 1)), int(self._rng.integers(0, 10)))
+            elif seq:
+                seq[int(self._rng.integers(len(seq)))] = int(self._rng.integers(0, 10))
+            result[i].set_values(seq)
+        return result
+
+
+def main():
+    problem = SequenceProblem()
+    ga = GeneticAlgorithm(
+        problem,
+        operators=[CutAndSplice(problem, tournament_size=3), SequenceMutation(problem)],
+        popsize=32,
+    )
+    ga.run(args.generations or 40)
+    best = ga.status["best"]
+    print("best sequence:", list(best.values), "fitness:", round(float(ga.status["best_eval"]), 2))
+
+
+if __name__ == "__main__":
+    main()

@@ -190,3 +190,55 @@ def test_batched_radius_init():
     x = xnes(center_init=jnp.ones((2, 4)), objective_sense="min", radius_init=jnp.array([1.0, 2.0]))
     assert x.A.shape == (2, 4, 4)
     assert np.allclose(np.asarray(x.A[1, 0, 0]), 1.0)
+
+
+def test_functional_ga_single_objective():
+    from evotorch_tpu.algorithms.functional import default_variation, ga, ga_ask, ga_tell
+
+    key = jax.random.key(0)
+    init = jax.random.uniform(key, (32, 6), minval=-5.0, maxval=5.0)
+    # a fresh evaluated state enters lax.scan directly (constant treedef)
+    state = ga(values_init=init, evals_init=sphere(init), objective_sense="min")
+    variation = default_variation(tournament_size=4, mutation_stdev=0.2)
+
+    @jax.jit
+    def run(state, key):
+        def gen(state, key):
+            children = ga_ask(key, state, variation=variation)
+            return ga_tell(state, children, sphere(children)), None
+
+        return jax.lax.scan(gen, state, jax.random.split(key, 60))[0]
+
+    state = run(state, jax.random.key(1))
+    assert float(jnp.min(state.evals)) < 0.5
+
+
+def test_functional_ga_multiobjective():
+    from evotorch_tpu.algorithms.functional import default_variation, ga, ga_ask, ga_tell
+    from evotorch_tpu.operators.functional import pareto_ranks
+
+    def two_obj(xs):
+        return jnp.stack([sphere(xs), sphere(xs - 2.0)], axis=-1)
+
+    key = jax.random.key(2)
+    init = jax.random.uniform(key, (24, 4), minval=-3.0, maxval=3.0)
+    state = ga(values_init=init, evals_init=two_obj(init), objective_sense=["min", "min"])
+    variation = default_variation(tournament_size=3, eta=10.0, mutation_stdev=0.1)
+    for i in range(25):
+        k = jax.random.key(10 + i)
+        children = ga_ask(k, state, variation=variation)
+        state = ga_tell(state, children, two_obj(children))
+    ranks = np.asarray(pareto_ranks(state.evals, objective_sense=["min", "min"]))
+    assert (ranks == 0).sum() >= len(ranks) // 2
+
+
+
+def test_functional_ga_misuse():
+    from evotorch_tpu.algorithms.functional import default_variation, ga
+
+    with pytest.raises(ValueError):
+        default_variation(num_points=3, eta=10.0)
+    with pytest.raises(ValueError):
+        ga(values_init=jnp.zeros(5), evals_init=jnp.zeros(5), objective_sense="min")
+    with pytest.raises(ValueError):
+        ga(values_init=jnp.zeros((4, 2)), evals_init=jnp.zeros(3), objective_sense="min")
