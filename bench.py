@@ -4,8 +4,9 @@ The driver runs this on real TPU hardware and records the single JSON line
 printed to stdout. Metric: environment steps per second through the flagship
 path — ``run_vectorized_rollout`` (one jitted program containing the whole
 population x env x time loop) driven by PGPE, popsize 10k, MLP policy on the
-pure-JAX Swimmer2D locomotion env (the stand-in for Brax Humanoid, which is
-not installed in this image; see BASELINE.md north star: >1M env-steps/sec).
+pure-JAX SLIP Hopper locomotion env (contact dynamics; the stand-in for Brax
+Humanoid, which is not installed in this image; see BASELINE.md north star:
+>1M env-steps/sec). ``BENCH_ENV`` selects any registered env.
 
 ``vs_baseline`` = env_steps_per_sec / 1_000_000 (the north-star target).
 """
@@ -49,19 +50,23 @@ def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
     from evotorch_tpu.algorithms.functional import pgpe, pgpe_ask, pgpe_tell
-    from evotorch_tpu.envs import Swimmer2D
+    from evotorch_tpu.envs import make_env
     from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
     from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
     from evotorch_tpu.neuroevolution.net.vecrl import run_vectorized_rollout
 
-    popsize = int(os.environ.get("BENCH_POPSIZE", 10_000))
-    episode_length = int(os.environ.get("BENCH_EPISODE_LENGTH", 200))
+    # on the CPU fallback, default to smaller sizes so the benchmark cannot
+    # stall the driver (popsize 10k x 200 steps is a TPU-sized program)
+    default_popsize = 1024 if use_cpu else 10_000
+    default_episode_length = 100 if use_cpu else 200
+    popsize = int(os.environ.get("BENCH_POPSIZE", default_popsize))
+    episode_length = int(os.environ.get("BENCH_EPISODE_LENGTH", default_episode_length))
     generations = int(os.environ.get("BENCH_GENERATIONS", 3))
     # opt-in: bf16 changes the measured compute dtype, so keep the default
     # comparable with previously recorded f32 baselines
     compute_dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
 
-    env = Swimmer2D(n_links=6)
+    env = make_env(os.environ.get("BENCH_ENV", "hopper"))
     net = (
         Linear(env.observation_size, 64)
         >> Tanh()
@@ -132,6 +137,11 @@ def main():
                 "value": round(steps_per_sec, 1),
                 "unit": "env_steps/sec",
                 "vs_baseline": round(steps_per_sec / 1_000_000, 4),
+                "env": os.environ.get("BENCH_ENV", "hopper"),
+                "popsize": popsize,
+                "episode_length": episode_length,
+                "compute_dtype": str(compute_dtype.__name__ if compute_dtype else "float32"),
+                "backend": "cpu-fallback" if use_cpu else "tpu",
             }
         )
     )

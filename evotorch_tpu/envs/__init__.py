@@ -15,6 +15,7 @@ brax env when brax is installed (import-gated), mirroring the reference's
 
 from .base import Env, EnvState, Space
 from .classic import Acrobot, CartPole, MountainCarContinuous, Pendulum, Swimmer2D
+from .hopper import Hopper
 from .registry import make_env, register_env
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "Acrobot",
     "MountainCarContinuous",
     "Swimmer2D",
+    "Hopper",
     "make_env",
     "register_env",
 ]

@@ -1,0 +1,136 @@
+"""Planar SLIP hopper: locomotion with contact dynamics.
+
+A spring-loaded inverted pendulum (SLIP) monopod — the canonical reduced
+model of running (Blickhan 1989; Raibert's hoppers). Unlike the toy swimmer,
+this env has genuine hybrid dynamics (ballistic flight, compliant stance,
+touchdown/liftoff events), all expressed with ``jnp.where`` phase masking so
+the whole thing stays jittable — the benchmark stand-in for Brax-style
+locomotion in this image (Brax is not installed).
+
+Controls: target leg angle during flight (foot placement) and stance thrust
+(spring precompression, Raibert's energy-injection scheme). Reward: forward
+velocity minus control cost; the episode ends when the body falls.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..tools.pytree import replace
+from .base import Env, EnvState, Space
+
+__all__ = ["Hopper"]
+
+
+class Hopper(Env):
+    max_episode_steps = 1000
+
+    def __init__(self):
+        self.observation_space = Space(shape=(7,))
+        self.action_space = Space(
+            shape=(2,), lb=jnp.array([-1.0, 0.0]), ub=jnp.array([1.0, 1.0])
+        )
+        self.g = 9.81
+        self.m = 1.0  # body mass
+        self.r0 = 1.0  # rest leg length
+        self.k = 150.0  # spring stiffness
+        self.dt = 0.02
+        self.substeps = 4
+        self.max_leg_angle = 0.5  # rad, from vertical
+        self.max_thrust = 0.15  # max spring precompression (m)
+        self.fall_height = 0.35
+
+    # state vector: [x, z, vx, vz, leg_angle, foot_x, in_stance]
+    def _obs(self, s):
+        x, z, vx, vz, theta, foot_x, stance = s
+        # leg compression is observable in stance
+        r = jnp.where(
+            stance > 0.5,
+            jnp.sqrt(jnp.maximum((x - foot_x) ** 2 + z**2, 1e-6)),
+            self.r0,
+        )
+        return jnp.stack([z, vx, vz, theta, r, stance, jnp.sin(theta)])
+
+    def reset(self, key):
+        key, sub = jax.random.split(key)
+        perturb = jax.random.uniform(sub, (2,), minval=-0.05, maxval=0.05)
+        s = jnp.array([0.0, 1.05 + perturb[0], 0.0 + perturb[1], 0.0, 0.0, 0.0, 0.0])
+        return EnvState(obs_state=s, t=jnp.zeros((), jnp.int32), key=key), self._obs(s)
+
+    def _substep(self, s, action):
+        x, z, vx, vz, theta, foot_x, stance = s
+        target_angle = self.max_leg_angle * action[0]
+        thrust = self.max_thrust * jnp.clip(action[1], 0.0, 1.0)
+        h = self.dt / self.substeps
+
+        # flight: ballistic body, leg servos toward the target angle
+        theta_flight = theta + jnp.clip(target_angle - theta, -8.0 * h, 8.0 * h)
+        z_flight = z + h * vz
+        x_flight = x + h * vx
+        vz_flight = vz - h * self.g
+
+        # touchdown check (after the flight integration)
+        foot_height = z_flight - self.r0 * jnp.cos(theta_flight)
+        touchdown = (stance < 0.5) & (foot_height <= 0.0) & (vz_flight < 0.0)
+        new_foot_x = jnp.where(
+            touchdown, x_flight + self.r0 * jnp.sin(theta_flight), foot_x
+        )
+
+        # stance: spring force along the leg (with thrust precompression).
+        # the contact is unilateral — the ground can only push, so the spring
+        # force clamps at zero once the leg extends past its (precompressed)
+        # rest length; without the clamp the leg would act as a tether and
+        # yank fast forward hops back down
+        dx = x - new_foot_x
+        r = jnp.sqrt(jnp.maximum(dx**2 + z**2, 1e-6))
+        leg_dir_x = dx / r
+        leg_dir_z = z / r
+        spring_force = jnp.maximum(self.k * (self.r0 + thrust - r), 0.0)
+        ax = spring_force * leg_dir_x / self.m
+        az = spring_force * leg_dir_z / self.m - self.g
+        vx_stance = vx + h * ax
+        vz_stance = vz + h * az
+        x_stance = x + h * vx_stance
+        z_stance = z + h * vz_stance
+        # same sign convention as flight: positive theta = foot forward of body
+        theta_stance = jnp.arctan2(new_foot_x - x_stance, z_stance)
+
+        # liftoff: the leg reached its rest length (force has hit zero)
+        r_new = jnp.sqrt(jnp.maximum((x_stance - new_foot_x) ** 2 + z_stance**2, 1e-6))
+        liftoff = (stance > 0.5) & (r_new >= self.r0 + thrust)
+
+        in_stance = jnp.where(stance > 0.5, ~liftoff, touchdown)
+
+        pick = lambda a, b: jnp.where(stance > 0.5, a, b)  # noqa: E731
+        s_next = jnp.stack(
+            [
+                pick(x_stance, x_flight),
+                pick(z_stance, z_flight),
+                pick(vx_stance, vx),
+                pick(vz_stance, vz_flight),
+                pick(theta_stance, theta_flight),
+                new_foot_x,
+                in_stance.astype(jnp.float32),
+            ]
+        )
+        return s_next
+
+    def step(self, state: EnvState, action):
+        action = jnp.clip(
+            jnp.reshape(action, (2,)), self.action_space.lb, self.action_space.ub
+        )
+        s = state.obs_state
+
+        def body(i, s):
+            return self._substep(s, action)
+
+        s = jax.lax.fori_loop(0, self.substeps, body, s)
+        t = state.t + 1
+        fallen = s[1] < self.fall_height
+        done = fallen | (t >= self.max_episode_steps)
+        reward = s[2] - 0.001 * jnp.sum(action**2) + 0.5  # forward speed + alive
+        reward = jnp.where(fallen, reward - 2.0, reward)
+        return replace(state, obs_state=s, t=t), self._obs(s), reward, done

@@ -25,8 +25,8 @@ def make_env(name: str, **kwargs) -> Env:
     """Instantiate an environment by name.
 
     Plain names (``"cartpole"``, ``"pendulum"``, ``"acrobot"``,
-    ``"mountain_car_continuous"``, ``"swimmer"``) resolve to the pure-JAX
-    suite. ``"brax::<env>"`` adapts brax (requires brax installed)."""
+    ``"mountain_car_continuous"``, ``"swimmer"``, ``"hopper"``) resolve to the
+    pure-JAX suite. ``"brax::<env>"`` adapts brax (requires brax installed)."""
     if name.startswith("brax::"):
         from .braxenv import BraxEnvAdapter
 
@@ -50,6 +50,10 @@ def _register_defaults():
     register_env("mountain_car_continuous", MountainCarContinuous)
     register_env("mountaincarcontinuous", MountainCarContinuous)
     register_env("swimmer", Swimmer2D)
+
+    from .hopper import Hopper
+
+    register_env("hopper", Hopper)
 
 
 _register_defaults()

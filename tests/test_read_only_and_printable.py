@@ -1,0 +1,56 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.tools.readonlytensor import (
+    ReadOnlyTensor,
+    as_read_only_tensor,
+    is_read_only,
+    read_only_tensor,
+)
+from evotorch_tpu.tools.recursiveprintable import RecursivePrintable
+
+
+# reference test_read_only_tensor.py analog: on TPU the discipline is
+# immutability-by-construction, so the checks are about coercion semantics
+
+
+def test_jax_arrays_are_read_only():
+    x = jnp.ones(3)
+    assert isinstance(x, ReadOnlyTensor)
+    assert is_read_only(x)
+    assert as_read_only_tensor(x) is x
+
+
+def test_numpy_becomes_unwritable_view():
+    arr = np.arange(4.0)
+    view = as_read_only_tensor(arr)
+    assert is_read_only(view)
+    with pytest.raises(ValueError):
+        view[0] = 9.0
+    # the original stays writable; the view shares storage
+    arr[0] = 5.0
+    assert view[0] == 5.0
+
+
+def test_read_only_tensor_copies():
+    out = read_only_tensor([1.0, 2.0])
+    assert is_read_only(out)
+    assert out.shape == (2,)
+
+
+def test_recursive_printable():
+    class Thing(RecursivePrintable):
+        def _printable_items(self):
+            return {"a": 1, "nested": [1, {"b": 2}]}
+
+    s = str(Thing())
+    assert "Thing" in s and "a=1" in s and "'b': 2" in s
+
+    class Looper(RecursivePrintable):
+        def _printable_items(self):
+            return {"self": self}
+
+    # bounded depth: no infinite recursion
+    s = Looper().to_string(max_depth=3)
+    assert "<...>" in s
