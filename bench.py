@@ -66,7 +66,11 @@ def main():
     # comparable with previously recorded f32 baselines
     compute_dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
 
-    env = make_env(os.environ.get("BENCH_ENV", "hopper"))
+    env_name = os.environ.get("BENCH_ENV", "hopper")
+    # BENCH_ENV_ARGS: JSON kwargs for the env factory (e.g. '{"n_links": 6}'
+    # reproduces the previously-benchmarked 6-link swimmer)
+    env_kwargs = json.loads(os.environ.get("BENCH_ENV_ARGS", "{}"))
+    env = make_env(env_name, **env_kwargs)
     net = (
         Linear(env.observation_size, 64)
         >> Tanh()
@@ -137,7 +141,8 @@ def main():
                 "value": round(steps_per_sec, 1),
                 "unit": "env_steps/sec",
                 "vs_baseline": round(steps_per_sec / 1_000_000, 4),
-                "env": os.environ.get("BENCH_ENV", "hopper"),
+                "env": env_name,
+                "env_args": env_kwargs,
                 "popsize": popsize,
                 "episode_length": episode_length,
                 "compute_dtype": str(compute_dtype.__name__ if compute_dtype else "float32"),

@@ -62,8 +62,9 @@ class Hopper(Env):
 
     def _substep(self, s, action):
         x, z, vx, vz, theta, foot_x, stance = s
+        # step() already clipped the action to the space bounds
         target_angle = self.max_leg_angle * action[0]
-        thrust = self.max_thrust * jnp.clip(action[1], 0.0, 1.0)
+        thrust = self.max_thrust * action[1]
         h = self.dt / self.substeps
 
         # flight: ballistic body, leg servos toward the target angle
