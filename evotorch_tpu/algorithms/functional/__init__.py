@@ -10,6 +10,7 @@ from .funcclipup import ClipUpState, clipup, clipup_ask, clipup_tell
 from .funccem import CEMState, cem, cem_ask, cem_tell
 from .funcga import GAState, default_variation, ga, ga_ask, ga_tell
 from .funccmaes import CMAESState, cmaes, cmaes_ask, cmaes_tell
+from .funcmapelites import MAPElitesState, mapelites, mapelites_ask, mapelites_tell
 from .funcpgpe import PGPEState, pgpe, pgpe_ask, pgpe_tell
 from .funcsnes import SNESState, snes, snes_ask, snes_tell
 from .funcxnes import XNESState, xnes, xnes_ask, xnes_tell
@@ -38,6 +39,10 @@ __all__ = [
     "cmaes",
     "cmaes_ask",
     "cmaes_tell",
+    "MAPElitesState",
+    "mapelites",
+    "mapelites_ask",
+    "mapelites_tell",
     "PGPEState",
     "pgpe",
     "pgpe_ask",

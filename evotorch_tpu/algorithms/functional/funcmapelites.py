@@ -1,0 +1,93 @@
+"""Functional MAP-Elites: ``mapelites`` / ``mapelites_ask`` / ``mapelites_tell``.
+
+The OO ``MAPElites`` (``algorithms/mapelites.py``) wraps the Problem
+machinery; this is the pure pytree-state form, so a whole
+quality-diversity run — archive updates included — compiles into one
+``lax.scan``. The per-cell best-solution selection is the same vmapped kernel
+(reference ``mapelites.py:24-67``).
+
+Fitness convention: ``evals[:, 0]`` is the fitness, ``evals[:, 1:]`` are the
+feature coordinates (the reference's eval-data layout).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...tools.pytree import pytree_dataclass, replace, static_field
+from ..mapelites import _best_solutions_for_all_cells
+
+__all__ = ["MAPElitesState", "mapelites", "mapelites_ask", "mapelites_tell"]
+
+
+@pytree_dataclass
+class MAPElitesState:
+    values: jnp.ndarray  # (num_cells, L) archive decision values
+    evals: jnp.ndarray  # (num_cells, 1 + num_features)
+    filled: jnp.ndarray  # (num_cells,) bool
+    feature_grid: jnp.ndarray  # (num_cells, num_features, 2)
+    objective_sense: str = static_field()
+
+
+def mapelites(
+    *,
+    values_init: jnp.ndarray,
+    evals_init: jnp.ndarray,
+    feature_grid,
+    objective_sense: str,
+) -> MAPElitesState:
+    """Initial archive from an **evaluated** seed population (one candidate
+    per cell; extra/missing rows are resolved by the first tell)."""
+    values_init = jnp.asarray(values_init)
+    evals_init = jnp.asarray(evals_init)
+    feature_grid = jnp.asarray(feature_grid)
+    if objective_sense not in ("min", "max"):
+        raise ValueError(f"objective_sense must be 'min' or 'max', got {objective_sense!r}")
+    if feature_grid.ndim != 3 or feature_grid.shape[-1] != 2:
+        raise ValueError(
+            f"feature_grid must be (num_cells, num_features, 2); got {feature_grid.shape}"
+        )
+    num_cells = feature_grid.shape[0]
+    if evals_init.ndim != 2 or evals_init.shape[1] != 1 + feature_grid.shape[1]:
+        raise ValueError(
+            f"evals_init must be (N, 1 + num_features) = (N, {1 + feature_grid.shape[1]}); "
+            f"got {evals_init.shape}"
+        )
+    # place the seed population into cells via one selection pass
+    values, evals, filled = _best_solutions_for_all_cells(
+        objective_sense, values_init, evals_init, feature_grid
+    )
+    return MAPElitesState(
+        values=values,
+        evals=evals,
+        filled=filled,
+        feature_grid=feature_grid,
+        objective_sense=objective_sense,
+    )
+
+
+def mapelites_ask(key, state: MAPElitesState, *, mutate: Callable) -> jnp.ndarray:
+    """Children: mutate the current archive occupants (one child per cell —
+    the vectorized emit step). ``mutate(key, values) -> values``."""
+    return mutate(key, state.values)
+
+
+def mapelites_tell(state: MAPElitesState, child_values, child_evals) -> MAPElitesState:
+    """Insert children: for every cell, keep the best candidate (current
+    occupant or any child) whose features fall inside the cell bounds."""
+    child_values = jnp.asarray(child_values)
+    child_evals = jnp.asarray(child_evals)
+    # candidates = current archive + children; unfilled archive rows are
+    # masked out by pushing their fitness to the losing extreme
+    bad = jnp.inf if state.objective_sense == "min" else -jnp.inf
+    arch_fitness = jnp.where(state.filled, state.evals[:, 0], bad)
+    arch_evals = state.evals.at[:, 0].set(arch_fitness)
+    all_values = jnp.concatenate([state.values, child_values], axis=0)
+    all_evals = jnp.concatenate([arch_evals, child_evals], axis=0)
+    values, evals, filled = _best_solutions_for_all_cells(
+        state.objective_sense, all_values, all_evals, state.feature_grid
+    )
+    return replace(state, values=values, evals=evals, filled=filled)

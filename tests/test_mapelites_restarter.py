@@ -113,3 +113,50 @@ def test_functional_cmaes():
     assert float(best[-1]) < 0.05
     assert float(best[-1]) < float(best[0])
     assert int(state.iteration) == 120
+
+
+def test_functional_mapelites_scan():
+    import jax
+
+    from evotorch_tpu.algorithms import MAPElites
+    from evotorch_tpu.algorithms.functional import mapelites, mapelites_ask, mapelites_tell
+
+    def fit_and_features(xs):
+        fitness = jnp.sum(xs**2, axis=-1)
+        return jnp.concatenate([fitness[:, None], xs[:, :1]], axis=1)
+
+    grid = MAPElites.make_feature_grid([-2.0], [2.0], num_bins=[8])
+    key = jax.random.key(0)
+    seed_pop = jax.random.uniform(key, (32, 3), minval=-2.0, maxval=2.0)
+    state = mapelites(
+        values_init=seed_pop,
+        evals_init=fit_and_features(seed_pop),
+        feature_grid=grid,
+        objective_sense="min",
+    )
+    initial_filled = int(np.asarray(state.filled).sum())
+
+    def mutate(key, values):
+        return values + 0.2 * jax.random.normal(key, values.shape)
+
+    @jax.jit
+    def run(state, key):
+        def gen(state, key):
+            children = mapelites_ask(key, state, mutate=mutate)
+            return mapelites_tell(state, children, fit_and_features(children)), None
+
+        return jax.lax.scan(gen, state, jax.random.split(key, 40))[0]
+
+    state = run(state, jax.random.key(1))
+    assert int(np.asarray(state.filled).sum()) >= max(initial_filled, 6)
+    # occupants' features actually lie inside their cells
+    g = np.asarray(grid)
+    evals = np.asarray(state.evals)
+    filled = np.asarray(state.filled)
+    for i in range(8):
+        if filled[i]:
+            assert g[i, 0, 0] <= evals[i, 1] <= g[i, 0, 1]
+    # fitness within each filled cell only improves across further telling
+    state2 = run(state, jax.random.key(2))
+    both = filled & np.asarray(state2.filled)
+    assert (np.asarray(state2.evals)[both, 0] <= evals[both, 0] + 1e-6).all()

@@ -20,7 +20,9 @@ import pytest
 _WORKER = textwrap.dedent(
     """
     import os, sys
+    import numpy as np
     proc_id = int(sys.argv[1])
+    port = sys.argv[2]
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -28,7 +30,7 @@ _WORKER = textwrap.dedent(
     from evotorch_tpu.parallel import init_distributed
 
     init_distributed(
-        coordinator_address="localhost:23457", num_processes=2, process_id=proc_id
+        coordinator_address=f"localhost:{port}", num_processes=2, process_id=proc_id
     )
     assert jax.device_count() == 4, jax.device_count()
 
@@ -53,20 +55,28 @@ _WORKER = textwrap.dedent(
          "divide_mu_grad_by": "num_directions", "divide_sigma_grad_by": "num_directions"},
     )
     mu_grad = np.asarray(grads["mu"].addressable_data(0)) if hasattr(grads["mu"], "addressable_data") else np.asarray(grads["mu"])
-    import numpy as np
     print("GRAD", proc_id, ",".join(f"{v:.6f}" for v in np.asarray(mu_grad)))
     """
 )
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
 def test_two_process_sharded_gradients(tmp_path):
     worker = tmp_path / "worker.py"
-    worker.write_text("import numpy as np\n" + _WORKER)
+    worker.write_text(_WORKER)
+    port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i)],
+            [sys.executable, str(worker), str(i), str(port)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
