@@ -12,9 +12,8 @@ feature coordinates (the reference's eval-data layout).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Union
+from typing import Callable
 
-import jax
 import jax.numpy as jnp
 
 from ...tools.pytree import pytree_dataclass, replace, static_field
@@ -44,6 +43,12 @@ def mapelites(
     values_init = jnp.asarray(values_init)
     evals_init = jnp.asarray(evals_init)
     feature_grid = jnp.asarray(feature_grid)
+    if values_init.ndim != 2:
+        raise ValueError(f"values_init must be (N, L); got {values_init.shape}")
+    if evals_init.shape[0] != values_init.shape[0]:
+        raise ValueError(
+            f"evals_init has {evals_init.shape[0]} rows for {values_init.shape[0]} solutions"
+        )
     if objective_sense not in ("min", "max"):
         raise ValueError(f"objective_sense must be 'min' or 'max', got {objective_sense!r}")
     if feature_grid.ndim != 3 or feature_grid.shape[-1] != 2:
@@ -80,6 +85,10 @@ def mapelites_tell(state: MAPElitesState, child_values, child_evals) -> MAPElite
     occupant or any child) whose features fall inside the cell bounds."""
     child_values = jnp.asarray(child_values)
     child_evals = jnp.asarray(child_evals)
+    if child_evals.shape[0] != child_values.shape[0]:
+        raise ValueError(
+            f"child_evals has {child_evals.shape[0]} rows for {child_values.shape[0]} children"
+        )
     # candidates = current archive + children; unfilled archive rows are
     # masked out by pushing their fitness to the losing extreme
     bad = jnp.inf if state.objective_sense == "min" else -jnp.inf

@@ -99,3 +99,20 @@ def test_step_seconds_in_status():
     s = CEM(p, popsize=10, parenthood_ratio=0.5, stdev_init=1.0)
     s.step()
     assert s.status["step_seconds"] > 0
+
+
+def test_ne_searcher_pickles_whole():
+    # VecNE problems (with env + flat-params policy inside) checkpoint whole
+    from evotorch_tpu.algorithms import PGPE
+    from evotorch_tpu.neuroevolution import VecNE
+
+    problem = VecNE("pendulum", "Linear(obs_length, act_length)", episode_length=10, seed=0)
+    searcher = PGPE(
+        problem, popsize=8, center_learning_rate=0.3, stdev_learning_rate=0.1, stdev_init=0.3
+    )
+    searcher.run(2)
+    import pickle
+
+    restored = pickle.loads(pickle.dumps(searcher))
+    restored.run(2)
+    assert restored.step_count == 4

@@ -384,3 +384,19 @@ def test_num_actors_single_device_noop():
     p = Problem("min", sphere, solution_length=3, initial_bounds=(-1, 1), num_actors=1)
     p.evaluate(p.generate_batch(4))
     assert p._sharded_evaluator is None
+
+
+def test_evaluate_single_solution():
+    p = make_problem()
+    batch = p.generate_batch(3)
+    p.evaluate(batch[1])
+    assert bool(batch[1].is_evaluated)
+    # the rest of the batch is untouched
+    assert not batch.is_evaluated
+
+
+def test_split_max_size():
+    p = make_problem()
+    batch = p.generate_batch(10)
+    pieces = batch.split(max_size=3)
+    assert [len(pc) for pc in pieces] == [3, 3, 2, 2]

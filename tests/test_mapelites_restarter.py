@@ -160,3 +160,49 @@ def test_functional_mapelites_scan():
     state2 = run(state, jax.random.key(2))
     both = filled & np.asarray(state2.filled)
     assert (np.asarray(state2.evals)[both, 0] <= evals[both, 0] + 1e-6).all()
+
+
+def test_cmaes_separable_higher_dim():
+    import jax
+
+    from evotorch_tpu.algorithms.functional.funccmaes import cmaes, cmaes_ask, cmaes_tell
+
+    d = 128
+    state = cmaes(
+        center_init=jnp.full((d,), 2.0),
+        stdev_init=1.0,
+        objective_sense="min",
+        popsize=32,
+        separable=True,
+    )
+    assert state.decompose_C_freq >= 1
+
+    @jax.jit
+    def run(state, key):
+        def gen(state, key):
+            state, xs = cmaes_ask(key, state)
+            return cmaes_tell(state, xs, jnp.sum(xs**2, axis=-1)), None
+
+        return jax.lax.scan(gen, state, jax.random.split(key, 150))[0]
+
+    state = run(state, jax.random.key(0))
+    assert float(jnp.linalg.norm(state.m)) < float(jnp.linalg.norm(jnp.full((d,), 2.0)))
+
+
+def test_functional_mapelites_shape_validation():
+    from evotorch_tpu.algorithms import MAPElites
+    from evotorch_tpu.algorithms.functional import mapelites, mapelites_tell
+
+    grid = MAPElites.make_feature_grid([-1.0], [1.0], num_bins=[4])
+    good_vals = jnp.zeros((5, 2))
+    good_evals = jnp.zeros((5, 2))
+    with pytest.raises(ValueError):
+        mapelites(values_init=good_vals, evals_init=jnp.zeros((8, 2)),
+                  feature_grid=grid, objective_sense="min")
+    with pytest.raises(ValueError):
+        mapelites(values_init=jnp.zeros(5), evals_init=good_evals,
+                  feature_grid=grid, objective_sense="min")
+    state = mapelites(values_init=good_vals, evals_init=good_evals,
+                      feature_grid=grid, objective_sense="min")
+    with pytest.raises(ValueError):
+        mapelites_tell(state, jnp.zeros((3, 2)), jnp.zeros((4, 2)))
