@@ -156,6 +156,9 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
 
         # sharded-evaluation request (replaces actor config; reference core.py:1302-1595)
         self._num_actors_requested = num_actors
+        if num_subbatches is not None and subbatch_size is not None:
+            # mutual exclusion, matching the reference (core.py:1288-1293)
+            raise ValueError("Provide at most one of num_subbatches / subbatch_size")
         self._num_subbatches = num_subbatches
         self._subbatch_size = subbatch_size
         self._sharded_evaluator = None
@@ -337,6 +340,25 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         sharded evaluator has been installed (``use_sharded_evaluation``),
         the population axis is sharded over the mesh instead."""
         self._resolve_num_actors_request()
+        use_subbatches = (
+            self._num_subbatches is not None or self._subbatch_size is not None
+        ) and self._sharded_evaluator is None
+        # with a sharded evaluator, sub-batching is skipped: the mesh already
+        # bounds per-device rows, and pieces smaller than the device count
+        # would only pad back up to it
+        if use_subbatches:
+            # evaluation in pieces (reference core.py:1282-1295 + 2583-2600):
+            # bounds per-evaluation memory; results scatter back into `batch`
+            if self._num_subbatches is not None:
+                pieces = batch.split(min(int(self._num_subbatches), len(batch)))
+            else:
+                pieces = batch.split(max_size=int(self._subbatch_size))
+            for piece in pieces:
+                self._eval_possibly_sharded(piece)
+            return
+        self._eval_possibly_sharded(batch)
+
+    def _eval_possibly_sharded(self, batch: "SolutionBatch"):
         if self._sharded_evaluator is not None:
             try:
                 evals = self._sharded_evaluator(batch.values)

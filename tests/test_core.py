@@ -400,3 +400,51 @@ def test_split_max_size():
     batch = p.generate_batch(10)
     pieces = batch.split(max_size=3)
     assert [len(pc) for pc in pieces] == [3, 3, 2, 2]
+
+
+def test_subbatch_evaluation():
+    # reference core.py:1282-1295: evaluation proceeds in pieces
+    seen_sizes = []
+
+    @vectorized
+    def spying_sphere(xs):
+        seen_sizes.append(int(xs.shape[0]))
+        return jnp.sum(xs**2, axis=-1)
+
+    p = Problem("min", spying_sphere, solution_length=3, initial_bounds=(-1, 1),
+                subbatch_size=4)
+    batch = p.generate_batch(10)
+    p.evaluate(batch)
+    assert batch.is_evaluated
+    assert seen_sizes == [4, 4, 2] or seen_sizes == [4, 3, 3]
+    expected = np.sum(np.asarray(batch.values) ** 2, axis=-1)
+    assert np.allclose(np.asarray(batch.evals[:, 0]), expected, atol=1e-6)
+
+    seen_sizes.clear()
+    p2 = Problem("min", spying_sphere, solution_length=3, initial_bounds=(-1, 1),
+                 num_subbatches=2)
+    batch2 = p2.generate_batch(10)
+    p2.evaluate(batch2)
+    assert seen_sizes == [5, 5]
+    assert batch2.is_evaluated
+
+    # both knobs at once are mutually exclusive (reference core.py:1288-1293)
+    with pytest.raises(ValueError):
+        Problem("min", spying_sphere, solution_length=3, initial_bounds=(-1, 1),
+                num_subbatches=2, subbatch_size=3)
+
+    # more subbatches than solutions: clamps (no empty pieces), and a single
+    # Solution evaluates fine
+    p3 = Problem("min", spying_sphere, solution_length=3, initial_bounds=(-1, 1),
+                 num_subbatches=8)
+    b3 = p3.generate_batch(3)
+    p3.evaluate(b3)
+    assert b3.is_evaluated
+    p3.evaluate(p3.generate_batch(2)[0])
+
+    # sharded evaluator active: sub-batching is skipped (mesh bounds rows)
+    p4 = Problem("min", sphere, solution_length=3, initial_bounds=(-1, 1),
+                 subbatch_size=2, num_actors="max")
+    b4 = p4.generate_batch(16)
+    p4.evaluate(b4)
+    assert b4.is_evaluated
