@@ -129,6 +129,7 @@ def main():
     elapsed = time.perf_counter() - t0
 
     steps_per_sec = total_steps / elapsed
+    generations_per_sec = generations / elapsed
     print(
         f"{generations} generations, {total_steps} env-steps in {elapsed:.2f}s; "
         f"mean score {float(jnp.mean(scores)):.3f}",
@@ -141,6 +142,7 @@ def main():
                 "value": round(steps_per_sec, 1),
                 "unit": "env_steps/sec",
                 "vs_baseline": round(steps_per_sec / 1_000_000, 4),
+                "generations_per_sec": round(generations_per_sec, 3),
                 "env": env_name,
                 "env_args": env_kwargs,
                 "popsize": popsize,

@@ -159,6 +159,10 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         if num_subbatches is not None and subbatch_size is not None:
             # mutual exclusion, matching the reference (core.py:1288-1293)
             raise ValueError("Provide at most one of num_subbatches / subbatch_size")
+        if num_subbatches is not None and int(num_subbatches) < 1:
+            raise ValueError(f"num_subbatches must be >= 1, got {num_subbatches}")
+        if subbatch_size is not None and int(subbatch_size) < 1:
+            raise ValueError(f"subbatch_size must be >= 1, got {subbatch_size}")
         self._num_subbatches = num_subbatches
         self._subbatch_size = subbatch_size
         self._sharded_evaluator = None
@@ -346,7 +350,7 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         # with a sharded evaluator, sub-batching is skipped: the mesh already
         # bounds per-device rows, and pieces smaller than the device count
         # would only pad back up to it
-        if use_subbatches:
+        if use_subbatches and len(batch) > 0:
             # evaluation in pieces (reference core.py:1282-1295 + 2583-2600):
             # bounds per-evaluation memory; results scatter back into `batch`
             if self._num_subbatches is not None:
@@ -375,7 +379,9 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
                     e,
                 )
                 self._sharded_evaluator = None
-                self._evaluate_batch(batch)
+                # re-enter through _evaluate_all so the sub-batching knobs
+                # (skipped while the sharded evaluator was active) apply
+                self._evaluate_all(batch)
                 return
             batch.set_evals(*self._split_eval_outputs(evals))
             return
