@@ -10,11 +10,12 @@ Package entry parity: reference ``src/evotorch/__init__.py:29-38`` re-exports
 ``Problem, Solution, SolutionBatch, ProblemBoundEvaluator`` and subpackages.
 """
 
-from . import checkpoint, decorators, distributions, envs, logging, models, neuroevolution, operators, ops, optimizers, parallel, testing, tools, utils
+from . import algorithms, checkpoint, decorators, distributions, envs, logging, models, neuroevolution, operators, ops, optimizers, parallel, testing, tools, utils
 from .core import Problem, ProblemBoundEvaluator, Solution, SolutionBatch, SolutionBatchPieces
 from .decorators import expects_ndim, on_aux_device, on_cuda, on_device, pass_info, rowwise, vectorized
 
 __all__ = [
+    "algorithms",
     "Problem",
     "ProblemBoundEvaluator",
     "Solution",

@@ -374,7 +374,7 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
 
                 set_default_logger_config().warning(
                     "sharded evaluation failed (%s: %s); falling back to "
-                    "single-program eager evaluation",
+                    "eager evaluation (honoring any sub-batching settings)",
                     type(e).__name__,
                     e,
                 )

@@ -477,6 +477,8 @@ def test_non_traceable_fallback_honors_subbatching():
     batch = p.generate_batch(12)
     p.evaluate(batch)
     assert batch.is_evaluated
-    # the first entry is the failed sharded *trace* (abstract values); the
-    # real evaluations afterwards proceeded in pieces
-    assert seen[1:] == [4, 4, 4]
+    # a failed sharded *trace* may record one abstract-shape call first
+    # (only when multiple devices are present); the real evaluations
+    # proceeded in pieces of at most subbatch_size
+    real_calls = [s for s in seen if s <= 4]
+    assert real_calls == [4, 4, 4]

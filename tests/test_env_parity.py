@@ -68,3 +68,22 @@ def test_acrobot_dynamics_match_gymnasium():
         ref_obs, *_ = ref.step(action)
         state, obs, _, _ = ours.step(state, jnp.asarray(action))
         assert np.allclose(np.asarray(obs), ref_obs, atol=1e-4), f"diverged at step {t}"
+
+
+def test_mountain_car_dynamics_match_gymnasium():
+    from evotorch_tpu.envs import MountainCarContinuous
+
+    ref = gym.make("MountainCarContinuous-v0").unwrapped
+    ours = MountainCarContinuous()
+    rng = np.random.default_rng(3)
+
+    ref.reset(seed=0)
+    start = np.asarray(ref.state, dtype=np.float64)
+    state, _ = ours.reset(jax.random.key(0))
+    state = replace(state, obs_state=jnp.asarray(start, dtype=jnp.float32))
+
+    for t in range(50):
+        action = rng.uniform(-1.0, 1.0, size=(1,))
+        ref_obs, *_ = ref.step(action)
+        state, obs, _, _ = ours.step(state, jnp.asarray(action, dtype=jnp.float32))
+        assert np.allclose(np.asarray(obs), ref_obs, atol=1e-4), f"diverged at step {t}"
