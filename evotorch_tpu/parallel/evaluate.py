@@ -53,6 +53,10 @@ def make_sharded_evaluator(
         n = values.shape[0]
         padded_n = -(-n // n_shards) * n_shards
         if padded_n != n:
+            # pad with copies of the first row: always a VALID genome, so
+            # fitness functions undefined at synthetic points (log/div at the
+            # zero vector) and jax_debug_nans stay safe; the padded results
+            # are discarded below
             pad = jnp.broadcast_to(values[:1], (padded_n - n,) + values.shape[1:])
             padded = jnp.concatenate([values, pad], axis=0)
         else:
