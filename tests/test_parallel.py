@@ -117,3 +117,22 @@ def test_dryrun_multichip_various_topologies():
     # even and odd device counts; both must compile + execute
     g.dryrun_multichip(2)
     g.dryrun_multichip(3)
+
+
+def test_sharded_evaluator_multi_output():
+    # fitness functions may return (fitness, eval_data) pytrees
+    @vectorized
+    def with_extra(xs):
+        return jnp.sum(xs**2, axis=-1), jnp.stack([xs[:, 0], xs[:, 1]], axis=1)
+
+    ev = make_sharded_evaluator(with_extra)
+    values = jax.random.normal(jax.random.key(7), (24, 4))
+    fit, extra = ev(values)
+    assert fit.shape == (24,)
+    assert extra.shape == (24, 2)
+    ref_fit, ref_extra = with_extra(values)
+    assert np.allclose(np.asarray(fit), np.asarray(ref_fit), atol=1e-5)
+    assert np.allclose(np.asarray(extra), np.asarray(ref_extra), atol=1e-5)
+    # unaligned popsize too
+    fit13, extra13 = ev(values[:13])
+    assert fit13.shape == (13,) and extra13.shape == (13, 2)
