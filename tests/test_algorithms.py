@@ -174,3 +174,43 @@ def test_searcher_population_property():
         _ = s.population
     s.step()
     assert len(s.population) == 20
+
+
+def test_pgpe_adaptive_popsize_by_interactions():
+    # reference gaussian.py:296-349: with num_interactions set, the searcher
+    # keeps sampling sub-populations until the interaction budget is met
+    from evotorch_tpu.neuroevolution import VecNE
+
+    problem = VecNE(
+        "pendulum",
+        "Linear(obs_length, act_length)",
+        episode_length=20,
+        seed=0,
+    )
+    searcher = PGPE(
+        problem,
+        popsize=8,
+        center_learning_rate=0.2,
+        stdev_learning_rate=0.1,
+        stdev_init=0.3,
+        num_interactions=500,  # 8 envs x 20 steps = 160 per sub-population
+        popsize_max=64,
+    )
+    searcher.step()
+    # the population grew beyond the base popsize to satisfy the budget
+    assert searcher.status["popsize"] > 8
+    assert searcher.status["popsize"] <= 64
+    searcher.run(2)  # subsequent generations keep working
+
+
+def test_cosyne_sbx_branch():
+    s = Cosyne(
+        make_problem(),
+        popsize=32,
+        tournament_size=3,
+        mutation_stdev=0.3,
+        eta=12.0,  # SBX crossover instead of one-point
+        elitism_ratio=0.1,
+    )
+    first, last = improvement(s, gens=15)
+    assert last < first
