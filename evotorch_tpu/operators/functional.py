@@ -22,6 +22,7 @@ TPU-first notes:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple, Optional, Union
 
 import jax
@@ -227,6 +228,34 @@ def utility(evals, *, objective_sense: str, ranking_method: Optional[str] = "cen
     return rank(evals, ranking_method, higher_is_better=higher_is_better)
 
 
+
+
+def _apply_with_per_lane_keys(core, key, arg_specs, args, statics=()):
+    """Run ``core(*args_unbatched, *statics, key)`` with extra leading dims on
+    the arrays treated as batch dims — splitting the PRNG key per batch lane
+    so parallel (batched) searches get independent randomness.
+
+    ``arg_specs`` gives each array's core ndim. Batch shapes broadcast.
+    """
+    import math as _math
+
+    args = [jnp.asarray(a) for a in args]
+    batch_shape = ()
+    for a, nd in zip(args, arg_specs):
+        batch_shape = jnp.broadcast_shapes(batch_shape, a.shape[: a.ndim - nd])
+    if batch_shape == ():
+        return core(*args, *statics, key)
+    bsize = _math.prod(batch_shape)
+    flat = []
+    for a, nd in zip(args, arg_specs):
+        core_shape = a.shape[a.ndim - nd :]
+        flat.append(jnp.broadcast_to(a, batch_shape + core_shape).reshape((bsize,) + core_shape))
+    keys = jax.random.split(key, bsize)
+    out = jax.vmap(lambda *xs: core(*xs[:-1], *statics, xs[-1]))(*flat, keys)
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape(batch_shape + leaf.shape[1:]), out
+    )
+
 # ---------------------------------------------------------------------------
 # Tournament selection
 # ---------------------------------------------------------------------------
@@ -246,6 +275,7 @@ def _tournament_utilities(evals: jnp.ndarray, objective_sense) -> jnp.ndarray:
 
 
 @expects_ndim(1, None, None, None)
+@partial(jax.jit, static_argnums=(1, 2))
 def _tournament_indices(utilities, num_tournaments, tournament_size, key):
     """Two exclusive tournament sets (reference ``functional.py:500-578``):
     the winner of first-set tournament ``i`` is guaranteed not to participate
@@ -281,6 +311,8 @@ def tournament(
     """Random pairs of tournaments; winners form two parent sets
     (reference ``functional.py:817-990``). Result forms follow the reference:
     indices / values / (values, evals), optionally split into the two sets."""
+    num_tournaments = int(num_tournaments)
+    tournament_size = int(tournament_size)
     if num_tournaments % 2 != 0:
         raise ValueError(f"num_tournaments must be even, got {num_tournaments}")
     evals = jnp.asarray(evals)
@@ -389,7 +421,7 @@ def _maybe_tournament(key, parents, evals, tournament_size, num_children, object
     return key, p1, p2
 
 
-@expects_ndim(2, 2, None, None)
+@partial(jax.jit, static_argnums=(2,))
 def _kpoint_crossover_core(parents1, parents2, num_points, key):
     half, length = parents1.shape
     num_points = min(int(num_points), length - 1)
@@ -419,7 +451,9 @@ def multi_point_cross_over(
     parents = jnp.asarray(parents)
     key, p1, p2 = _maybe_tournament(key, parents, evals, tournament_size, num_children, objective_sense)
     key, sub = jax.random.split(key)
-    return _kpoint_crossover_core(p1, p2, int(num_points), sub)
+    return _apply_with_per_lane_keys(
+        _kpoint_crossover_core, sub, (2, 2), (p1, p2), statics=(int(num_points),)
+    )
 
 
 def one_point_cross_over(key, parents, evals=None, *, tournament_size=None, num_children=None, objective_sense=None):
@@ -438,7 +472,7 @@ def two_point_cross_over(key, parents, evals=None, *, tournament_size=None, num_
     )
 
 
-@expects_ndim(2, 2, 0, None)
+@jax.jit
 def _sbx_core(parents1, parents2, eta, key):
     u = jax.random.uniform(key, parents1.shape, dtype=parents1.dtype)
     beta = jnp.where(
@@ -465,7 +499,9 @@ def simulated_binary_cross_over(
     parents = jnp.asarray(parents)
     key, p1, p2 = _maybe_tournament(key, parents, evals, tournament_size, num_children, objective_sense)
     key, sub = jax.random.split(key)
-    return _sbx_core(p1, p2, jnp.asarray(eta, dtype=parents.dtype), sub)
+    return _apply_with_per_lane_keys(
+        _sbx_core, sub, (2, 2, 0), (p1, p2, jnp.asarray(eta, dtype=parents.dtype))
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -474,30 +510,41 @@ def simulated_binary_cross_over(
 # ---------------------------------------------------------------------------
 
 
-@expects_ndim(2, 0, None, None)
-def _gaussian_mutation_core(values, stdev, mutation_probability, key):
+@jax.jit
+def _gaussian_mutation_core(values, stdev, key):
+    noise = jax.random.normal(key, values.shape, dtype=values.dtype) * stdev
+    return values + noise
+
+
+@jax.jit
+def _gaussian_mutation_core_gated(values, stdev, mutation_probability, key):
+    # probability is a traced array: annealing it across generations reuses
+    # one compiled executable instead of recompiling per value
     key1, key2 = jax.random.split(key)
     noise = jax.random.normal(key1, values.shape, dtype=values.dtype) * stdev
-    if mutation_probability is not None:
-        mask = jax.random.uniform(key2, values.shape) < mutation_probability
-        noise = jnp.where(mask, noise, 0.0)
-    return values + noise
+    mask = jax.random.uniform(key2, values.shape) < mutation_probability
+    return values + jnp.where(mask, noise, 0.0)
 
 
 def gaussian_mutation(key, values, *, stdev, mutation_probability: Optional[float] = None):
     """Additive Gaussian noise, optionally per-element gated
-    (reference OO operator ``operators/real.py:30-66``)."""
+    (reference OO operator ``operators/real.py:30-66``). Batched inputs get
+    independent noise per batch lane."""
     values = jnp.asarray(values)
-    return _gaussian_mutation_core(
-        values, jnp.asarray(stdev, dtype=values.dtype),
-        None if mutation_probability is None else float(mutation_probability), key,
+    stdev = jnp.asarray(stdev, dtype=values.dtype)
+    if mutation_probability is None:
+        return _apply_with_per_lane_keys(
+            _gaussian_mutation_core, key, (2, 0), (values, stdev)
+        )
+    return _apply_with_per_lane_keys(
+        _gaussian_mutation_core_gated,
+        key,
+        (2, 0, 0),
+        (values, stdev, jnp.asarray(mutation_probability, dtype=values.dtype)),
     )
 
 
-@expects_ndim(2, 1, 1, 0, None, None)
-def _polynomial_mutation_core(values, lb, ub, eta, mutation_probability, key):
-    key1, key2 = jax.random.split(key)
-    u = jax.random.uniform(key1, values.shape, dtype=values.dtype)
+def _polynomial_delta(values, lb, ub, eta, u):
     span = ub - lb
     delta1 = (values - lb) / span
     delta2 = (ub - values) / span
@@ -507,22 +554,41 @@ def _polynomial_mutation_core(values, lb, ub, eta, mutation_probability, key):
     val1 = 2.0 * u + (1.0 - 2.0 * u) * xy1 ** (eta + 1.0)
     val2 = 2.0 * (1.0 - u) + 2.0 * (u - 0.5) * xy2 ** (eta + 1.0)
     deltaq = jnp.where(u <= 0.5, val1**mut_pow - 1.0, 1.0 - val2**mut_pow)
-    mutated = values + deltaq * span
-    if mutation_probability is not None:
-        mask = jax.random.uniform(key2, values.shape) < mutation_probability
-        mutated = jnp.where(mask, mutated, values)
-    return jnp.clip(mutated, lb, ub)
+    return values + deltaq * span
+
+
+@jax.jit
+def _polynomial_mutation_core(values, lb, ub, eta, key):
+    u = jax.random.uniform(key, values.shape, dtype=values.dtype)
+    return jnp.clip(_polynomial_delta(values, lb, ub, eta, u), lb, ub)
+
+
+@jax.jit
+def _polynomial_mutation_core_gated(values, lb, ub, eta, mutation_probability, key):
+    key1, key2 = jax.random.split(key)
+    u = jax.random.uniform(key1, values.shape, dtype=values.dtype)
+    mutated = _polynomial_delta(values, lb, ub, eta, u)
+    mask = jax.random.uniform(key2, values.shape) < mutation_probability
+    return jnp.clip(jnp.where(mask, mutated, values), lb, ub)
 
 
 def polynomial_mutation(key, values, *, lb, ub, eta: float = 20.0, mutation_probability: Optional[float] = None):
     """Bounded polynomial mutation (Deb & Deb 2014; reference OO operator
-    ``operators/real.py:484-604``)."""
+    ``operators/real.py:484-604``). Batched inputs get independent noise per
+    batch lane."""
     values = jnp.asarray(values)
     lb = jnp.broadcast_to(jnp.asarray(lb, dtype=values.dtype), values.shape[-1:])
     ub = jnp.broadcast_to(jnp.asarray(ub, dtype=values.dtype), values.shape[-1:])
-    return _polynomial_mutation_core(
-        values, lb, ub, jnp.asarray(eta, dtype=values.dtype),
-        None if mutation_probability is None else float(mutation_probability), key,
+    eta = jnp.asarray(eta, dtype=values.dtype)
+    if mutation_probability is None:
+        return _apply_with_per_lane_keys(
+            _polynomial_mutation_core, key, (2, 1, 1, 0), (values, lb, ub, eta)
+        )
+    return _apply_with_per_lane_keys(
+        _polynomial_mutation_core_gated,
+        key,
+        (2, 1, 1, 0, 0),
+        (values, lb, ub, eta, jnp.asarray(mutation_probability, dtype=values.dtype)),
     )
 
 
@@ -531,7 +597,7 @@ def polynomial_mutation(key, values, *, lb, ub, eta: float = 20.0, mutation_prob
 # ---------------------------------------------------------------------------
 
 
-@expects_ndim(2, None)
+@jax.jit
 def _cosyne_full_permutation(values, key):
     n, length = values.shape
     noise = jax.random.uniform(key, (n, length))
@@ -539,11 +605,11 @@ def _cosyne_full_permutation(values, key):
     return jnp.take_along_axis(values, order, axis=0)
 
 
-@expects_ndim(2, 1, None, None)
+@partial(jax.jit, static_argnums=(2,))
 def _cosyne_partial_permutation(values, evals, objective_sense, key):
     n = values.shape[0]
     key1, key2 = jax.random.split(key)
-    permuted = _cosyne_full_permutation.__wrapped__(values, key1)
+    permuted = _cosyne_full_permutation(values, key1)
     ranks = rank(evals, "linear", higher_is_better=(objective_sense == "max"))
     permutation_probs = 1.0 - ranks ** (1.0 / n)
     to_permute = jax.random.uniform(key2, values.shape) < permutation_probs[:, None]
@@ -564,10 +630,13 @@ def cosyne_permutation(
     (``p_permute = 1 - linear_rank ** (1/n)``)."""
     values = jnp.asarray(values)
     if permute_all:
-        return _cosyne_full_permutation(values, key)
+        return _apply_with_per_lane_keys(_cosyne_full_permutation, key, (2,), (values,))
     if evals is None or objective_sense is None:
         raise ValueError("When permute_all is False, `evals` and `objective_sense` are required")
-    return _cosyne_partial_permutation(values, evals, objective_sense, key)
+    return _apply_with_per_lane_keys(
+        lambda v, e, k: _cosyne_partial_permutation(v, e, objective_sense, k),
+        key, (2, 1), (values, jnp.asarray(evals)),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -607,6 +676,7 @@ def combine(a, b, *, objective_sense=None):
 
 
 @expects_ndim(2, 1, None, None)
+@partial(jax.jit, static_argnums=(2, 3))
 def _take_best_single_obj(values, evals, n, maximize):
     utilities = evals if maximize else -evals
     if n is None:
@@ -617,8 +687,9 @@ def _take_best_single_obj(values, evals, n, maximize):
 
 
 @expects_ndim(2, 2, None, None, None)
+@partial(jax.jit, static_argnums=(2, 3, 4))
 def _take_best_multi_obj(values, evals, n, objective_sense, crowdsort):
-    utilities = _pareto_utility.__wrapped__(evals, objective_sense, crowdsort)
+    utilities = _pareto_utility.__wrapped__(evals, list(objective_sense), crowdsort)
     _, idx = jax.lax.top_k(utilities, n)
     return values[idx], evals[idx]
 
@@ -647,9 +718,10 @@ def take_best(
         return values[list(idx)], jnp.asarray(evals_np[idx])
     values = jnp.asarray(values)
     evals = jnp.asarray(evals)
+    n = None if n is None else int(n)
     if isinstance(objective_sense, str):
         maximize = {"max": True, "min": False}[objective_sense]
         return _take_best_single_obj(values, evals, n, maximize)
     if n is None:
         raise ValueError("take_best with multiple objectives requires an explicit `n`")
-    return _take_best_multi_obj(values, evals, n, objective_sense, bool(crowdsort))
+    return _take_best_multi_obj(values, evals, n, tuple(objective_sense), bool(crowdsort))

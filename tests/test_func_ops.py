@@ -261,3 +261,55 @@ def test_batched_utility():
     u = F.utility(evals, objective_sense="min", ranking_method="centered")
     assert u.shape == (2, 3)
     assert np.allclose(np.asarray(u[0]), [0.5, 0.0, -0.5])
+
+
+def test_batched_mutation_independent_noise():
+    # review regression: batch lanes must get independent randomness
+    key = jax.random.key(0)
+    values = jnp.zeros((2, 8, 5))
+    out = F.gaussian_mutation(key, values, stdev=1.0)
+    assert out.shape == (2, 8, 5)
+    assert not np.allclose(np.asarray(out[0]), np.asarray(out[1]))
+    gated = F.gaussian_mutation(key, values, stdev=1.0, mutation_probability=0.5)
+    assert not np.allclose(np.asarray(gated[0]), np.asarray(gated[1]))
+
+    perm = F.cosyne_permutation(key, jnp.broadcast_to(jnp.arange(24.0).reshape(8, 3), (2, 8, 3)))
+    assert not np.allclose(np.asarray(perm[0]), np.asarray(perm[1]))
+
+    poly = F.polynomial_mutation(key, jnp.zeros((2, 8, 4)), lb=-1.0, ub=1.0)
+    assert not np.allclose(np.asarray(poly[0]), np.asarray(poly[1]))
+
+    parents = jnp.broadcast_to(
+        jnp.concatenate([jnp.zeros((4, 6)), jnp.ones((4, 6))]), (2, 8, 6)
+    )
+    kids = F.multi_point_cross_over(key, parents, num_points=1)
+    assert not np.allclose(np.asarray(kids[0]), np.asarray(kids[1]))
+
+    sbx = F.simulated_binary_cross_over(key, jnp.broadcast_to(jnp.linspace(-1, 1, 48).reshape(8, 6), (2, 8, 6)), eta=10.0)
+    assert not np.allclose(np.asarray(sbx[0]), np.asarray(sbx[1]))
+
+
+def test_int_array_arguments_accepted():
+    # review regression: 0-d integer arrays at public boundaries
+    values = jnp.arange(20.0).reshape(10, 2)
+    evals = jnp.arange(10.0)
+    idx = F.tournament(
+        jax.random.key(0), values, evals,
+        num_tournaments=jnp.asarray(6), tournament_size=np.int64(3),
+        objective_sense="max", return_indices=True,
+    )
+    assert idx.shape == (6,)
+    top_v, top_e = F.take_best(values, evals, np.asarray(2), objective_sense="max")
+    assert top_v.shape == (2, 2)
+
+
+def test_annealed_mutation_probability_no_retrace():
+    # probability is traced: many distinct values reuse one executable
+    key = jax.random.key(1)
+    values = jnp.zeros((16, 4))
+    outs = [
+        F.gaussian_mutation(key, values, stdev=1.0, mutation_probability=p)
+        for p in (0.1, 0.2, 0.3, 0.4, 0.5)
+    ]
+    dens = [float((o != 0).mean()) for o in outs]
+    assert dens == sorted(dens)  # higher probability -> more mutated entries
