@@ -89,6 +89,12 @@ def expects_ndim(
     shapes of different args broadcast together, so e.g. a ``(B, L)`` center
     and a scalar stdev batch cleanly — the basis of *batched searches*
     (SURVEY.md §1, parallel API style 2).
+
+    Caveat: keyword arguments are bound statically (not vmapped); pass
+    anything that should batch as a positional argument with a declared ndim.
+    PRNG keys passed through ``None`` slots are shared across batch lanes —
+    key-consuming callers that need per-lane independence must split keys
+    themselves (see ``operators.functional._apply_with_per_lane_keys``).
     """
 
     def decorator(fn: Callable) -> Callable:
