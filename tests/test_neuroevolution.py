@@ -280,3 +280,47 @@ def test_vecne_num_actors_uses_sharded_path():
     batch2 = problem.generate_batch(7)
     problem.evaluate(batch2)
     assert batch2.is_evaluated
+
+
+def test_vecne_discrete_env_sharded():
+    problem = VecNE(
+        "cartpole",
+        "Linear(obs_length, act_length)",
+        env_config={"continuous_actions": False},
+        seed=6,
+    )
+    batch = problem.generate_batch(16)
+    problem.evaluate_sharded(batch)
+    scores = np.asarray(batch.evals[:, 0])
+    assert (scores >= 1.0).all() and (scores <= 500.0).all()
+
+
+def test_supervised_ne_multiple_minibatches():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(128, 2)).astype(np.float32)
+    y = (X @ np.array([[2.0], [-1.0]], dtype=np.float32))
+    problem = SupervisedNE((X, y), "Linear(2, 1)", minibatch_size=16, num_minibatches=4, seed=0)
+    batch = problem.generate_batch(5)
+    problem.evaluate(batch)
+    assert batch.is_evaluated
+    assert (np.asarray(batch.evals[:, 0]) >= 0).all()  # averaged MSE losses
+
+
+def test_pickling_logger_exports_vecne_policy(tmp_path):
+    import pickle
+
+    from evotorch_tpu.algorithms import PGPE
+    from evotorch_tpu.logging import PicklingLogger
+
+    problem = VecNE("pendulum", "Linear(obs_length, act_length)", episode_length=10, seed=0)
+    searcher = PGPE(
+        problem, popsize=8, center_learning_rate=0.3, stdev_learning_rate=0.1, stdev_init=0.3
+    )
+    logger = PicklingLogger(searcher, interval=1, directory=str(tmp_path), verbose=False)
+    searcher.run(2)
+    with open(logger.last_file_name, "rb") as f:
+        payload = pickle.load(f)
+    assert "policy" in payload  # to_policy export of the center
+    module = payload["policy"]
+    y, _ = module.apply(module.init(jax.random.key(0)), jnp.zeros(3))
+    assert y.shape == (1,)
