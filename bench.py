@@ -4,9 +4,11 @@ The driver runs this on real TPU hardware and records the single JSON line
 printed to stdout. Metric: environment steps per second through the flagship
 path — ``run_vectorized_rollout`` (one jitted program containing the whole
 population x env x time loop) driven by PGPE, popsize 10k, MLP policy on the
-pure-JAX SLIP Hopper locomotion env (contact dynamics; the stand-in for Brax
-Humanoid, which is not installed in this image; see BASELINE.md north star:
->1M env-steps/sec). ``BENCH_ENV`` selects any registered env.
+pure-JAX Humanoid locomotion env (17 actuated DOF, 109-dim obs, contact
+dynamics on an 11-body maximal-coordinates sim — the Humanoid-class flagship
+matching the reference's Brax Humanoid north star; see BASELINE.md:
+>1M env-steps/sec). ``BENCH_ENV`` selects any registered env
+(e.g. ``hopper`` reproduces the round-1 SLIP-hopper numbers).
 
 ``vs_baseline`` = env_steps_per_sec / 1_000_000 (the north-star target).
 """
@@ -66,7 +68,7 @@ def main():
     # comparable with previously recorded f32 baselines
     compute_dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
 
-    env_name = os.environ.get("BENCH_ENV", "hopper")
+    env_name = os.environ.get("BENCH_ENV", "humanoid")
     # BENCH_ENV_ARGS: JSON kwargs for the env factory (e.g. '{"n_links": 6}'
     # reproduces the previously-benchmarked 6-link swimmer)
     env_kwargs = json.loads(os.environ.get("BENCH_ENV_ARGS", "{}"))

@@ -16,6 +16,7 @@ brax env when brax is installed (import-gated), mirroring the reference's
 from .base import Env, EnvState, Space
 from .classic import Acrobot, CartPole, MountainCarContinuous, Pendulum, Swimmer2D
 from .hopper import Hopper
+from .humanoid import Humanoid
 from .registry import make_env, register_env
 
 __all__ = [
@@ -28,6 +29,7 @@ __all__ = [
     "MountainCarContinuous",
     "Swimmer2D",
     "Hopper",
+    "Humanoid",
     "make_env",
     "register_env",
 ]

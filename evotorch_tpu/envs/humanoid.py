@@ -1,0 +1,255 @@
+"""Humanoid: the flagship 3-D locomotion workload (pure JAX, 17 actuated DOF).
+
+A MuJoCo-Humanoid-class biped built on the maximal-coordinates engine in
+``rigidbody.py``: 11 rigid bodies (torso, lower waist, pelvis, two thighs,
+two shins with feet, two upper and two lower arms), 10 joints carrying 17
+actuated rotational DOF (abdomen 3, hips 2x3, knees 2x1, shoulders 2x2,
+elbows 2x1 — the same DOF budget as Gymnasium's ``Humanoid-v4``), penalty
+ground contact on heel/toe/hand/pelvis/torso/head spheres, and a 109-dim
+observation. Reward shaping follows the MuJoCo task: forward velocity plus
+alive bonus minus control cost, terminating when the torso leaves the healthy
+height band.
+
+This is the workload class the reference reaches only through external Brax
+(``/root/reference/src/evotorch/neuroevolution/net/vecrl.py:1366-1490``) and
+whose PGPE recipe defines the north-star benchmark (``BASELINE.md``:
+popsize 10k). Everything here is jit/vmap-native, so the whole population
+rolls out inside one XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tools.pytree import replace
+from .base import Env, EnvState, Space
+from .rigidbody import (
+    BodyState,
+    SystemBuilder,
+    capsule_inertia,
+    joint_angles,
+    joint_velocities,
+    physics_step,
+    sphere_penetrations,
+)
+
+__all__ = ["Humanoid"]
+
+
+def _build_humanoid(act_mode: str = "position"):
+    b = SystemBuilder(
+        omega_pos=250.0,
+        omega_ang=200.0,
+        zeta=1.0,
+        limit_gain=4.0,
+        tone_ratio=0.1,
+        free_damping_ratio=0.1,
+        contact_k=20_000.0,
+        # near-critical contact damping: underdamped feet micro-bounce at
+        # ~13 Hz, and the bounce rectifies through friction into a steady
+        # yaw drift (vibration-motor effect) that topples passive standing
+        contact_c=350.0,
+        friction_mu=1.0,
+        # bounded by lever-arm stability: c * r^2 / I_shin * h < 2
+        tangent_damping=350.0,
+        act_mode=act_mode,
+    )
+
+    # Bodies: world COM positions in the standing reference pose
+    # (x forward, y left, z up; ground at z=0). Proportions and masses track
+    # the classic MuJoCo humanoid (~37 kg).
+    b.add_body("torso", (0, 0, 1.25), 8.3, capsule_inertia(8.3, 0.11, 0.30, "z"))
+    b.add_body("lwaist", (0, 0, 1.05), 2.0, capsule_inertia(2.0, 0.11, 0.16, "z"))
+    b.add_body("pelvis", (0, 0, 0.92), 6.0, capsule_inertia(6.0, 0.10, 0.26, "y"))
+    for side, sy in (("right", -1.0), ("left", 1.0)):
+        y = 0.1 * sy
+        b.add_body(f"{side}_thigh", (0, y, 0.63), 4.5, capsule_inertia(4.5, 0.06, 0.42, "z"))
+        b.add_body(f"{side}_shin", (0, y, 0.25), 3.0, capsule_inertia(3.0, 0.05, 0.40, "z"))
+    for side, sy in (("right", -1.0), ("left", 1.0)):
+        y = 0.17 * sy
+        b.add_body(f"{side}_upper_arm", (0, y, 1.24), 1.6, capsule_inertia(1.6, 0.04, 0.28, "z"))
+        b.add_body(f"{side}_lower_arm", (0, y, 0.98), 1.2, capsule_inertia(1.2, 0.035, 0.24, "z"))
+
+    # Joints: 17 actuated DOF. Free-axis order fixes the action layout:
+    #   0 abdomen_z, 1 abdomen_y, 2 abdomen_x,
+    #   3 r_hip_x, 4 r_hip_z, 5 r_hip_y, 6 r_knee,
+    #   7 l_hip_x, 8 l_hip_z, 9 l_hip_y, 10 l_knee,
+    #   11 r_shoulder_x, 12 r_shoulder_y, 13 r_elbow,
+    #   14 l_shoulder_x, 15 l_shoulder_y, 16 l_elbow
+    b.add_joint(
+        "torso", "lwaist", (0, 0, 1.13),
+        free_axes=("z", "y"), limits=[(-0.79, 0.79), (-1.31, 0.52)], gears=(40.0, 40.0),
+    )
+    b.add_joint(
+        "lwaist", "pelvis", (0, 0, 1.00),
+        free_axes=("x",), limits=[(-0.61, 0.61)], gears=(40.0,),
+    )
+    for side, sy in (("right", -1.0), ("left", 1.0)):
+        y = 0.1 * sy
+        # hip_x limits mirror left/right: adduction is toward the body.
+        hip_x = (-0.61, 0.17) if sy < 0 else (-0.17, 0.61)
+        hip_z = (-1.05, 0.61) if sy < 0 else (-0.61, 1.05)
+        b.add_joint(
+            "pelvis", f"{side}_thigh", (0, y, 0.84),
+            free_axes=("x", "z", "y"),
+            limits=[hip_x, hip_z, (-1.92, 0.35)],
+            gears=(40.0, 40.0, 120.0),
+        )
+        b.add_joint(
+            f"{side}_thigh", f"{side}_shin", (0, y, 0.42),
+            free_axes=("y",), limits=[(-0.05, 2.70)], gears=(80.0,),
+        )
+    for side, sy in (("right", -1.0), ("left", 1.0)):
+        y = 0.17 * sy
+        sh_x = (-1.48, 1.05) if sy < 0 else (-1.05, 1.48)
+        b.add_joint(
+            "torso", f"{side}_upper_arm", (0, y, 1.38),
+            free_axes=("x", "y"), limits=[sh_x, (-1.48, 1.05)], gears=(25.0, 25.0),
+        )
+        b.add_joint(
+            f"{side}_upper_arm", f"{side}_lower_arm", (0, y, 1.10),
+            free_axes=("y",), limits=[(-2.27, 0.05)], gears=(25.0,),
+        )
+
+    # Colliders. The first four spheres are the feet (heel + toe per side) —
+    # the observation exposes their contact state.
+    for side, sy in (("right", -1.0), ("left", 1.0)):
+        y = 0.1 * sy
+        b.add_sphere(f"{side}_shin", (-0.08, y, 0.045), 0.045)  # heel
+        b.add_sphere(f"{side}_shin", (0.15, y, 0.045), 0.045)  # toe
+    b.add_sphere("right_lower_arm", (0, -0.17, 0.87), 0.05)  # hand
+    b.add_sphere("left_lower_arm", (0, 0.17, 0.87), 0.05)
+    b.add_sphere("pelvis", (0, 0, 0.92), 0.09)
+    b.add_sphere("torso", (0, 0, 1.25), 0.11)
+    b.add_sphere("torso", (0, 0, 1.50), 0.09)  # head
+
+    return b.build()
+
+
+class Humanoid(Env):
+    """3-D humanoid locomotion. Observation (109-dim):
+
+    ====== =====================================================
+    dims   content
+    ====== =====================================================
+    1      torso height
+    4      torso orientation quaternion
+    3      torso linear velocity (world)
+    3      torso angular velocity (world)
+    17     joint angles (action-DOF order)
+    17     joint angular velocities (action-DOF order)
+    30     non-torso body COM positions relative to the torso
+    30     non-torso body velocities relative to the torso
+    4      foot contact depths (right heel/toe, left heel/toe)
+    ====== =====================================================
+
+    Action: 17 values in ``[-1, 1]``. With the default ``act_mode="position"``
+    they are PD servo targets (0 = reference pose, +/-1 = joint limits,
+    torque-clipped at the per-DOF gear); with ``act_mode="torque"`` they are
+    raw torques scaled by gear (``Humanoid-v4`` semantics).
+    Reward: ``1.25 * forward_velocity + 5.0 - 0.1 * ||action||^2`` while the
+    torso stays in the healthy height band, mirroring ``Humanoid-v4``.
+    """
+
+    max_episode_steps = 1000
+
+    def __init__(
+        self,
+        *,
+        forward_reward_weight: float = 1.25,
+        alive_bonus: float = 5.0,
+        ctrl_cost_weight: float = 0.1,
+        healthy_z_range=(0.85, 1.75),
+        reset_noise_scale: float = 0.01,
+        act_mode: str = "position",
+    ):
+        """``act_mode="position"`` (default): actions are PD target angles —
+        zero action actively holds the reference pose, which makes standing
+        metastable and gait discovery tractable for ES (the choice modern
+        Brax/MJX humanoid-training setups make). ``act_mode="torque"``
+        reproduces the MuJoCo ``Humanoid-v4`` raw-torque semantics."""
+        self.sys, self._default_pos = _build_humanoid(act_mode)
+        self.dt = 0.015
+        self.substeps = 8
+        self.forward_reward_weight = forward_reward_weight
+        self.alive_bonus = alive_bonus
+        self.ctrl_cost_weight = ctrl_cost_weight
+        self.healthy_z_range = healthy_z_range
+        self.reset_noise_scale = reset_noise_scale
+
+        na = self.sys.num_act
+        self.action_space = Space(shape=(na,), lb=-jnp.ones(na), ub=jnp.ones(na))
+        self.observation_space = Space(shape=(self._obs_dim(),))
+
+    def _obs_dim(self) -> int:
+        nb = self.sys.num_bodies
+        return 1 + 4 + 3 + 3 + self.sys.num_act + self.sys.num_act + 2 * 3 * (nb - 1) + 4
+
+    # -- helpers -----------------------------------------------------------
+    def _free_components(self, comps: jnp.ndarray) -> jnp.ndarray:
+        """Flatten per-joint axis components ``(nj, 3)`` to the 17-dim action
+        layout using the builder's action-index map."""
+        idx = self.sys.act_index  # (nj, 3) with num_act marking unactuated
+        # invert the map: out[idx[j, a]] = comps[j, a]; unactuated axes all
+        # land on the extra scratch slot, which is dropped
+        out = jnp.zeros(self.sys.num_act + 1, comps.dtype)
+        out = out.at[idx.reshape(-1)].set(comps.reshape(-1))
+        return out[: self.sys.num_act]
+
+    def _obs(self, st: BodyState) -> jnp.ndarray:
+        torso_pos = st.pos[0]
+        rel_pos = (st.pos[1:] - torso_pos).reshape(-1)
+        rel_vel = (st.vel[1:] - st.vel[0]).reshape(-1)
+        ja = self._free_components(joint_angles(self.sys, st))
+        jv = self._free_components(joint_velocities(self.sys, st))
+        feet = sphere_penetrations(self.sys, st)[:4]
+        return jnp.concatenate(
+            [
+                torso_pos[2:3],
+                st.quat[0],
+                st.vel[0],
+                st.ang[0],
+                ja,
+                jv,
+                rel_pos,
+                rel_vel,
+                feet,
+            ]
+        )
+
+    # -- Env protocol ------------------------------------------------------
+    def reset(self, key):
+        key, k1, k2 = jax.random.split(key, 3)
+        nb = self.sys.num_bodies
+        noise = self.reset_noise_scale
+        vel = noise * jax.random.normal(k1, (nb, 3))
+        ang = noise * jax.random.normal(k2, (nb, 3))
+        st = BodyState(
+            pos=self._default_pos,
+            quat=jnp.tile(jnp.asarray([1.0, 0.0, 0.0, 0.0]), (nb, 1)),
+            vel=vel,
+            ang=ang,
+        )
+        return EnvState(obs_state=st, t=jnp.zeros((), jnp.int32), key=key), self._obs(st)
+
+    def step(self, state: EnvState, action):
+        action = jnp.clip(
+            jnp.reshape(action, (self.sys.num_act,)),
+            self.action_space.lb,
+            self.action_space.ub,
+        )
+        st = physics_step(self.sys, state.obs_state, action, self.dt, self.substeps)
+        t = state.t + 1
+
+        z = st.pos[0, 2]
+        lo, hi = self.healthy_z_range
+        unhealthy = (z < lo) | (z > hi)
+        done = unhealthy | (t >= self.max_episode_steps)
+
+        forward_vel = st.vel[0, 0]
+        ctrl_cost = self.ctrl_cost_weight * jnp.sum(action**2)
+        reward = self.forward_reward_weight * forward_vel + self.alive_bonus - ctrl_cost
+        reward = jnp.where(unhealthy, reward - self.alive_bonus, reward)
+
+        return replace(state, obs_state=st, t=t), self._obs(st), reward, done

@@ -55,5 +55,9 @@ def _register_defaults():
 
     register_env("hopper", Hopper)
 
+    from .humanoid import Humanoid
+
+    register_env("humanoid", Humanoid)
+
 
 _register_defaults()

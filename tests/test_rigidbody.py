@@ -1,0 +1,331 @@
+"""Unit tests for the maximal-coordinates rigid-body engine and the Humanoid.
+
+The engine is the substrate of the flagship workload (see
+``evotorch_tpu/envs/rigidbody.py``); these tests pin down the math kernels
+(quaternions), conservation-level dynamics sanity (free fall, constraint
+integrity), and the Humanoid env contract (protocol, metastable standing,
+fall termination, vmap/jit).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.envs import Humanoid, make_env
+from evotorch_tpu.envs.rigidbody import (
+    BodyState,
+    SystemBuilder,
+    capsule_inertia,
+    joint_angles,
+    physics_step,
+    quat_conj,
+    quat_integrate,
+    quat_mul,
+    quat_rotate,
+    quat_rotate_inv,
+    quat_to_rotvec,
+    sphere_inertia,
+)
+
+
+def _quat_from_axis_angle(axis, angle):
+    axis = np.asarray(axis, dtype=np.float64)
+    axis = axis / np.linalg.norm(axis)
+    return jnp.asarray(
+        np.concatenate([[np.cos(angle / 2)], np.sin(angle / 2) * axis]),
+        dtype=jnp.float32,
+    )
+
+
+class TestQuaternions:
+    def test_mul_identity(self):
+        q = _quat_from_axis_angle([0, 0, 1], 0.7)
+        e = jnp.asarray([1.0, 0, 0, 0])
+        np.testing.assert_allclose(np.asarray(quat_mul(e, q)), np.asarray(q), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(quat_mul(q, e)), np.asarray(q), atol=1e-6)
+
+    def test_rotate_matches_known_rotation(self):
+        # 90 deg about z sends x to y
+        q = _quat_from_axis_angle([0, 0, 1], np.pi / 2)
+        v = jnp.asarray([1.0, 0.0, 0.0])
+        np.testing.assert_allclose(
+            np.asarray(quat_rotate(q, v)), [0.0, 1.0, 0.0], atol=1e-6
+        )
+
+    def test_rotate_inv_roundtrip(self):
+        key = jax.random.key(0)
+        q = jax.random.normal(key, (5, 4))
+        q = q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+        v = jax.random.normal(jax.random.key(1), (5, 3))
+        back = quat_rotate_inv(q, quat_rotate(q, v))
+        np.testing.assert_allclose(np.asarray(back), np.asarray(v), atol=1e-5)
+
+    def test_conj_is_inverse(self):
+        q = _quat_from_axis_angle([1, 2, 3], 0.9)
+        e = quat_mul(q, quat_conj(q))
+        np.testing.assert_allclose(np.asarray(e), [1, 0, 0, 0], atol=1e-6)
+
+    def test_rotvec_roundtrip(self):
+        for axis, angle in [([0, 0, 1], 0.3), ([1, 0, 0], 1.2), ([1, 1, 0], 2.0)]:
+            q = _quat_from_axis_angle(axis, angle)
+            rv = np.asarray(quat_to_rotvec(q))
+            expected = np.asarray(axis, dtype=np.float64)
+            expected = expected / np.linalg.norm(expected) * angle
+            np.testing.assert_allclose(rv, expected, atol=1e-5)
+
+    def test_rotvec_identity_is_zero(self):
+        rv = quat_to_rotvec(jnp.asarray([1.0, 0.0, 0.0, 0.0]))
+        np.testing.assert_allclose(np.asarray(rv), [0, 0, 0], atol=1e-7)
+
+    def test_rotvec_takes_shortest_arc(self):
+        # q and -q are the same rotation; rotvec must not return a >pi arc
+        q = _quat_from_axis_angle([0, 0, 1], 0.5)
+        rv_neg = np.asarray(quat_to_rotvec(-q))
+        np.testing.assert_allclose(rv_neg, [0, 0, 0.5], atol=1e-5)
+
+    def test_integrate_constant_rate(self):
+        # integrating omega = (0,0,w) for t seconds yields angle ~ w*t
+        q = jnp.asarray([1.0, 0.0, 0.0, 0.0])
+        omega = jnp.asarray([0.0, 0.0, 2.0])
+        h = 0.001
+        for _ in range(500):
+            q = quat_integrate(q, omega, h)
+        angle = float(jnp.linalg.norm(quat_to_rotvec(q)))
+        assert abs(angle - 1.0) < 1e-2
+
+    def test_inertia_helpers(self):
+        c = capsule_inertia(2.0, 0.1, 0.4, "z")
+        assert c[0] == c[1] and c[2] == pytest.approx(0.5 * 2.0 * 0.01)
+        s = sphere_inertia(1.0, 0.1)
+        assert np.allclose(s, 0.4 * 1.0 * 0.01)
+
+
+def _single_body_system():
+    b = SystemBuilder()
+    b.add_body("ball", (0, 0, 2.0), 1.0, sphere_inertia(1.0, 0.1))
+    b.add_sphere("ball", (0, 0, 2.0), 0.1)
+    return b.build()
+
+
+class TestEngine:
+    def test_free_fall_parabola(self):
+        sys_, pos0 = _single_body_system()
+        st = BodyState(
+            pos=pos0,
+            quat=jnp.asarray([[1.0, 0, 0, 0]]),
+            vel=jnp.zeros((1, 3)),
+            ang=jnp.zeros((1, 3)),
+        )
+        t, dt, sub = 0.5, 0.01, 4
+        step = jax.jit(lambda s: physics_step(sys_, s, jnp.zeros(0), dt, sub))
+        for _ in range(int(t / dt)):
+            st = step(st)
+        # z = z0 - g t^2 / 2 (semi-implicit Euler is first-order accurate)
+        expected = 2.0 - 0.5 * 9.81 * t**2
+        assert abs(float(st.pos[0, 2]) - expected) < 0.05
+
+    def test_ground_contact_stops_fall(self):
+        sys_, pos0 = _single_body_system()
+        st = BodyState(
+            pos=pos0.at[0, 2].set(0.3),
+            quat=jnp.asarray([[1.0, 0, 0, 0]]),
+            vel=jnp.zeros((1, 3)),
+            ang=jnp.zeros((1, 3)),
+        )
+        step = jax.jit(lambda s: physics_step(sys_, s, jnp.zeros(0), 0.01, 4))
+        for _ in range(200):
+            st = step(st)
+        # rests near the surface: sphere radius 0.1 minus static penetration
+        z = float(st.pos[0, 2])
+        assert 0.05 < z < 0.12
+        assert abs(float(st.vel[0, 2])) < 0.05
+
+    def test_pendulum_joint_holds(self):
+        # one body hanging from a fixed-ish heavy anchor body by a hinge:
+        # anchor separation must stay small while the pendulum swings
+        b = SystemBuilder()
+        b.add_body("anchor", (0, 0, 2.0), 1000.0, sphere_inertia(1000.0, 0.5))
+        b.add_body("bob", (0, 0, 1.5), 1.0, capsule_inertia(1.0, 0.05, 0.5, "z"))
+        b.add_joint(
+            "anchor", "bob", (0, 0, 1.75),
+            free_axes=("y",), limits=[(-3.0, 3.0)], gears=(0.0,), tone=0.0,
+        )
+        sys_, pos0 = b.build()
+        st = BodyState(
+            pos=pos0,
+            quat=jnp.tile(jnp.asarray([1.0, 0, 0, 0]), (2, 1)),
+            vel=jnp.asarray([[0, 0, 0], [1.0, 0, 0]]),  # kick the bob
+            ang=jnp.zeros((2, 3)),
+        )
+        from evotorch_tpu.envs.rigidbody import quat_rotate as qr
+
+        step = jax.jit(lambda s: physics_step(sys_, s, jnp.zeros(1), 0.01, 8))
+        peak = 0.0
+        for _ in range(100):
+            st = step(st)
+            pa = st.pos[0] + qr(st.quat[0], sys_.anchor_p[0])
+            pb = st.pos[1] + qr(st.quat[1], sys_.anchor_c[0])
+            assert float(jnp.linalg.norm(pb - pa)) < 0.02
+            peak = max(peak, abs(float(joint_angles(sys_, st)[0, 1])))
+        # the bob should actually have swung
+        assert peak > 0.05
+
+    def test_actuation_position_vs_torque(self):
+        def build(mode):
+            b = SystemBuilder(act_mode=mode)
+            b.add_body("anchor", (0, 0, 2.0), 1000.0, sphere_inertia(1000.0, 0.5))
+            b.add_body("bob", (0, 0, 1.5), 1.0, capsule_inertia(1.0, 0.05, 0.5, "z"))
+            b.add_joint(
+                "anchor", "bob", (0, 0, 1.75),
+                free_axes=("y",), limits=[(-1.0, 1.0)], gears=(30.0,),
+            )
+            return b.build()
+
+        for mode in ("position", "torque"):
+            sys_, pos0 = build(mode)
+            st = BodyState(
+                pos=pos0,
+                quat=jnp.tile(jnp.asarray([1.0, 0, 0, 0]), (2, 1)),
+                vel=jnp.zeros((2, 3)),
+                ang=jnp.zeros((2, 3)),
+            )
+            step = jax.jit(lambda s, _sys=sys_: physics_step(_sys, s, jnp.asarray([0.5]), 0.01, 8))
+            for _ in range(150):
+                st = step(st)
+            angle = float(joint_angles(sys_, st)[0, 1])
+            assert angle > 0.2, f"{mode}: actuation did not move the joint"
+        # position mode tracks the commanded target (0.5 * hi = 0.5 rad)
+        sys_, pos0 = build("position")
+        st = BodyState(
+            pos=pos0,
+            quat=jnp.tile(jnp.asarray([1.0, 0, 0, 0]), (2, 1)),
+            vel=jnp.zeros((2, 3)),
+            ang=jnp.zeros((2, 3)),
+        )
+        step = jax.jit(lambda s: physics_step(sys_, s, jnp.asarray([0.5]), 0.01, 8))
+        for _ in range(300):
+            st = step(st)
+        angle = float(joint_angles(sys_, st)[0, 1])
+        assert abs(angle - 0.5) < 0.15
+
+
+class TestHumanoid:
+    def test_protocol_and_shapes(self):
+        env = Humanoid()
+        assert env.observation_size == 109
+        assert env.action_size == 17
+        state, obs = env.reset(jax.random.key(0))
+        assert obs.shape == (109,)
+        state, obs, reward, done = env.step(state, jnp.zeros(17))
+        assert obs.shape == (109,)
+        assert reward.shape == () and done.shape == ()
+        assert np.isfinite(np.asarray(obs)).all()
+
+    def test_metastable_standing(self):
+        # zero action (PD holds the reference pose) must survive >= 50
+        # control steps (0.75 s) before tipping — i.e. episodes are not
+        # dead-on-arrival, but balance still requires active control
+        env = Humanoid()
+        step = jax.jit(env.step)
+        s, _ = env.reset(jax.random.key(0))
+        for i in range(50):
+            s, obs, r, d = step(s, jnp.zeros(17))
+            assert not bool(d), f"fell at step {i}"
+        assert float(s.obs_state.pos[0, 2]) > 1.0
+
+    def test_random_actions_stay_finite(self):
+        env = Humanoid()
+        step = jax.jit(env.step)
+        s, _ = env.reset(jax.random.key(1))
+        key = jax.random.key(2)
+        for _ in range(150):
+            key, sub = jax.random.split(key)
+            a = jax.random.uniform(sub, (17,), minval=-1, maxval=1)
+            s, obs, r, d = step(s, a)
+            assert np.isfinite(np.asarray(obs)).all()
+            assert np.isfinite(float(r))
+
+    def test_joint_integrity_under_load(self):
+        from evotorch_tpu.envs.rigidbody import quat_rotate as qr
+
+        env = Humanoid()
+        step = jax.jit(env.step)
+        s, _ = env.reset(jax.random.key(3))
+        key = jax.random.key(4)
+        for _ in range(100):
+            key, sub = jax.random.split(key)
+            s, obs, r, d = step(s, jax.random.uniform(sub, (17,), minval=-1, maxval=1))
+        st = s.obs_state
+        sys_ = env.sys
+        pa = st.pos[sys_.joint_parent] + qr(st.quat[sys_.joint_parent], sys_.anchor_p)
+        pb = st.pos[sys_.joint_child] + qr(st.quat[sys_.joint_child], sys_.anchor_c)
+        sep = jnp.linalg.norm(pb - pa, axis=-1)
+        assert float(sep.max()) < 0.05
+
+    def test_falls_terminate(self):
+        env = Humanoid()
+        step = jax.jit(env.step)
+        s, _ = env.reset(jax.random.key(5))
+        # command an extreme asymmetric crouch-twist: must fall eventually
+        a = jnp.ones(17).at[3:7].set(-1.0)
+        fell = False
+        for _ in range(300):
+            s, obs, r, d = step(s, a)
+            if bool(d):
+                fell = True
+                break
+        assert fell
+
+    def test_unhealthy_reward_drops_alive_bonus(self):
+        env = Humanoid()
+        s, _ = env.reset(jax.random.key(0))
+        # teleport the torso below the healthy band
+        from evotorch_tpu.tools.pytree import replace
+
+        st = s.obs_state
+        low = replace(s, obs_state=st._replace(pos=st.pos.at[:, 2].add(-1.0)))
+        _, _, r_unhealthy, d = env.step(low, jnp.zeros(17))
+        assert bool(d)
+        _, _, r_healthy, _ = env.step(s, jnp.zeros(17))
+        # the alive bonus is withdrawn on the unhealthy terminal step
+        assert float(r_healthy) - float(r_unhealthy) > 0.5 * env.alive_bonus
+
+    def test_vmapped_and_jitted(self):
+        env = Humanoid()
+        n = 4
+        keys = jax.random.split(jax.random.key(0), n)
+        states, obs = jax.vmap(env.reset)(keys)
+        assert obs.shape == (n, 109)
+        vstep = jax.jit(jax.vmap(env.step))
+        states, obs, rewards, dones = vstep(states, jnp.zeros((n, 17)))
+        assert rewards.shape == (n,)
+        assert np.isfinite(np.asarray(obs)).all()
+
+    def test_registry_and_torque_mode(self):
+        assert isinstance(make_env("humanoid"), Humanoid)
+        env = make_env("humanoid", act_mode="torque")
+        s, _ = env.reset(jax.random.key(0))
+        s, obs, r, d = env.step(s, jnp.zeros(17))
+        assert np.isfinite(np.asarray(obs)).all()
+
+    def test_determinism(self):
+        env = Humanoid()
+        s1, o1 = env.reset(jax.random.key(11))
+        s2, o2 = env.reset(jax.random.key(11))
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
+        s1, o1, r1, _ = env.step(s1, jnp.ones(17) * 0.3)
+        s2, o2, r2, _ = env.step(s2, jnp.ones(17) * 0.3)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
+
+    def test_forward_motion_rewarded(self):
+        from evotorch_tpu.tools.pytree import replace
+
+        env = Humanoid()
+        s, _ = env.reset(jax.random.key(0))
+        st = s.obs_state
+        moving = st._replace(vel=st.vel.at[:, 0].add(2.0))
+        s_moving = replace(s, obs_state=moving)
+        _, _, r_moving, _ = env.step(s_moving, jnp.zeros(17))
+        _, _, r_still, _ = env.step(s, jnp.zeros(17))
+        assert float(r_moving) > float(r_still)
