@@ -209,6 +209,7 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         first_count = problem.status.get("total_interaction_count", 0)
         batches = []
         total_popsize = 0
+        prev_made = -1
         while True:
             batch = self._sample_population(self._popsize)
             problem.evaluate(batch)
@@ -221,6 +222,9 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
                 break
             if "total_interaction_count" not in problem.status:
                 break  # the problem does not report interactions; avoid looping forever
+            if interactions_made <= prev_made:
+                break  # counter stopped advancing; the budget is unreachable
+            prev_made = interactions_made
         self._population = batches[0] if len(batches) == 1 else SolutionBatch.cat(batches)
 
     def _step_non_distributed(self):

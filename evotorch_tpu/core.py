@@ -166,6 +166,9 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         self._num_subbatches = num_subbatches
         self._subbatch_size = subbatch_size
         self._sharded_evaluator = None
+        self._eval_mesh = None  # mesh backing the sharded evaluator, if any
+        self._eval_axis_name = "pop"
+        self._sharded_grad_cache: dict = {}
 
         # solution stats (reference core.py:2334)
         self._store_solution_stats = True if store_solution_stats is None else bool(store_solution_stats)
@@ -366,10 +369,12 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         if self._sharded_evaluator is not None:
             try:
                 evals = self._sharded_evaluator(batch.values)
-            except Exception as e:  # noqa: BLE001 — graceful degradation
-                # the objective turned out not to be jax-traceable (the
-                # reference runs arbitrary Python in actors; we cannot) —
-                # fall back to eager evaluation instead of crashing
+            except jax.errors.JAXTypeError as e:
+                # the objective turned out not to be jax-traceable (tracer
+                # leaked into host code — the reference runs arbitrary Python
+                # in actors; we cannot): fall back to eager evaluation.
+                # Genuine bugs (shape errors, NaN checks, ...) re-raise —
+                # silently running them N-times slower would mask them
                 from .tools.misc import set_default_logger_config
 
                 set_default_logger_config().warning(
@@ -378,7 +383,7 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
                     type(e).__name__,
                     e,
                 )
-                self._sharded_evaluator = None
+                self._drop_sharded_evaluation()
                 # re-enter through _evaluate_all so the sub-batching knobs
                 # (skipped while the sharded evaluator was active) apply
                 self._evaluate_all(batch)
@@ -425,6 +430,8 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
 
         mesh = default_mesh(("pop",), devices=jax.devices()[:n])
         self._sharded_evaluator = make_sharded_evaluator(self._objective_func, mesh=mesh)
+        self._eval_mesh = mesh
+        self._eval_axis_name = "pop"
 
     def _evaluate_batch(self, batch: "SolutionBatch"):
         """Vectorized objective call or per-solution loop
@@ -522,9 +529,15 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
 
         if not self._vectorized or self._objective_func is None:
             raise ValueError("Sharded evaluation requires a @vectorized objective_func")
+        if mesh is None:
+            from .parallel.mesh import default_mesh
+
+            mesh = default_mesh((axis_name,))
         self._sharded_evaluator = make_sharded_evaluator(
             self._objective_func, mesh=mesh, axis_name=axis_name
         )
+        self._eval_mesh = mesh
+        self._eval_axis_name = axis_name
         return self
 
     # ------------------------------------ distributed ES-gradient estimation
@@ -545,11 +558,50 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         single SPMD program does the work (shard the evaluation via
         ``use_sharded_evaluation``) and the list has one entry. The
         weighted-average step in the algorithm layer then degenerates to the
-        identity, exactly as a ``psum`` over one shard would."""
+        identity, exactly as a ``psum`` over one shard would.
+
+        When a sharded evaluator is active (``use_sharded_evaluation`` or
+        ``num_actors``) and no interaction budget is set, the pipeline instead
+        runs the reference's *exact* distributed statistics
+        (``core.py:3156-3301`` + ``gaussian.py:199-272``): each mesh shard
+        samples its own sub-population, ranks **locally**, computes local
+        gradients, and a ``pmean`` replaces the main-process weighted average
+        (shards are equal-sized, so both weighting conventions coincide)."""
         if key is None:
             key = self.next_rng_key()
         self._start_preparations()
         self.before_grad_hook()
+
+        self._resolve_num_actors_request()
+        if (
+            self._eval_mesh is not None
+            and self._eval_mesh.shape[self._eval_axis_name] > 1
+            and num_interactions is None
+            and self._vectorized
+            and self._objective_func is not None
+        ):
+            try:
+                result = self._sharded_sample_and_compute_gradients(
+                    distribution, popsize, obj_index=obj_index,
+                    ranking_method=ranking_method, key=key,
+                )
+            except jax.errors.JAXTypeError as e:
+                # the objective is not jax-traceable: degrade to the
+                # single-program path, mirroring _eval_possibly_sharded
+                from .tools.misc import set_default_logger_config
+
+                set_default_logger_config().warning(
+                    "sharded gradient estimation failed (%s: %s); falling "
+                    "back to single-program sampling with global ranking",
+                    type(e).__name__,
+                    e,
+                )
+                self._drop_sharded_evaluation()
+            else:
+                hook_results = self.after_grad_hook.accumulate_dict(result)
+                if hook_results:
+                    self._status.update(hook_results)
+                return [result]
 
         def sample_and_eval(key, n):
             samples = distribution.sample(int(n), key=key)
@@ -567,6 +619,7 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
             sample_chunks = []
             fitness_chunks = []
             total = 0
+            prev_made = -1
             while True:
                 key, sub = jax.random.split(key)
                 s, f = sample_and_eval(sub, popsize)
@@ -580,6 +633,12 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
                     break
                 if "total_interaction_count" not in self._status:
                     break  # the problem does not report interactions
+                if made <= prev_made:
+                    # the problem stopped updating its interaction counter —
+                    # without this guard (and with no popsize_max) the budget
+                    # would never be reached and the loop would spin forever
+                    break
+                prev_made = made
             all_samples = jnp.concatenate(sample_chunks, axis=0)
             all_fitnesses = jnp.concatenate(fitness_chunks, axis=0)
 
@@ -598,6 +657,65 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         if hook_results:
             self._status.update(hook_results)
         return [result]
+
+    def _drop_sharded_evaluation(self):
+        """Forget the sharded evaluator AND everything derived from its mesh,
+        so a fallback (or a later ``use_sharded_evaluation`` with a different
+        mesh) never reuses stale sharded programs."""
+        self._sharded_evaluator = None
+        self._eval_mesh = None
+        self._sharded_grad_cache.clear()
+
+    def _sharded_sample_and_compute_gradients(
+        self, distribution, popsize: int, *, obj_index: int, ranking_method, key
+    ) -> dict:
+        """Shard-local sampling/ranking/gradients over the eval mesh
+        (reference semantics: per-actor local ranking,
+        ``core.py:3156-3301``)."""
+        from .parallel.grad import make_sharded_grad_estimator
+
+        mesh = self._eval_mesh
+        axis = self._eval_axis_name
+        n_shards = mesh.shape[axis]
+        dist_cls = type(distribution)
+        # round the shard-local popsize up so every shard gets the same
+        # (and, for antithetic distributions, even) sub-population — the
+        # analog of the reference's near-equal split_workload pieces
+        local = -(-int(popsize) // n_shards)
+        if dist_cls.SAMPLES_MUST_BE_EVEN and local % 2 != 0:
+            local += 1
+        total = local * n_shards
+        ranking = ranking_method if ranking_method is not None else "raw"
+        sense = self._senses[obj_index]
+
+        cache_key = (dist_cls, ranking, obj_index, sense, mesh, axis)
+        estimator = self._sharded_grad_cache.get(cache_key)
+        if estimator is None:
+
+            def fitness_for_grad(values):
+                outputs = self._split_eval_outputs(self._objective_func(values))
+                fitnesses = jnp.asarray(outputs[0])
+                if fitnesses.ndim == 2:
+                    fitnesses = fitnesses[:, obj_index]
+                return fitnesses
+
+            estimator = make_sharded_grad_estimator(
+                dist_cls,
+                fitness_for_grad,
+                objective_sense=sense,
+                ranking_method=ranking,
+                mesh=mesh,
+                axis_name=axis,
+                with_aux=True,
+            )
+            self._sharded_grad_cache[cache_key] = estimator
+
+        grads, aux = estimator(key, total, distribution.parameters)
+        return {
+            "gradients": grads,
+            "num_solutions": int(total),
+            "mean_eval": float(aux["mean_eval"]),
+        }
 
     # ----------------------------------------------------------------- misc
     def ensure_numeric(self):

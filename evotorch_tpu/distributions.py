@@ -104,6 +104,9 @@ class Distribution(TensorMakerMixin, Serializable, RecursivePrintable):
     MANDATORY_PARAMETERS: set = set()
     OPTIONAL_PARAMETERS: set = set()
     PARAMETER_NDIMS: dict = {}
+    #: antithetic distributions require an even sample count per draw; the
+    #: sharded grad estimator uses this to round shard-local popsizes
+    SAMPLES_MUST_BE_EVEN: bool = False
 
     functional_sample: Optional[Callable] = None
 
@@ -359,6 +362,8 @@ def _make_class_functional_sample(cls):
 class SymmetricSeparableGaussian(SeparableGaussian):
     """Antithetic separable Gaussian, the PGPE default
     (reference ``distributions.py:616-773``)."""
+
+    SAMPLES_MUST_BE_EVEN = True
 
     @classmethod
     def _sample(cls, key, parameters, num_solutions):

@@ -412,8 +412,6 @@ class SystemBuilder:
         act_kp_ratio: float = 1.0,
         act_kd_ratio: float = 1.0,
     ):
-        if act_mode not in ("torque", "position"):
-            raise ValueError(f"act_mode must be 'torque' or 'position', got {act_mode!r}")
         """``omega_pos``/``omega_ang`` (rad/s) are the target constraint
         frequencies; actual spring constants are scaled per joint by the
         reduced mass/inertia of the connected body pair, keeping every
@@ -423,6 +421,8 @@ class SystemBuilder:
         spring; ``tone_ratio`` adds a weak passive spring pulling free DOF
         toward the reference pose (muscle tone); ``free_damping_ratio``
         scales free-axis damping relative to the lock damping."""
+        if act_mode not in ("torque", "position"):
+            raise ValueError(f"act_mode must be 'torque' or 'position', got {act_mode!r}")
         self._params = dict(
             gravity=np.asarray([0.0, 0.0, gravity]),
             omega_pos=omega_pos,

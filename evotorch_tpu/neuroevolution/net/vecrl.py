@@ -186,6 +186,11 @@ def run_vectorized_rollout(
     key, sub = jax.random.split(key)
     reset_keys = jax.random.split(sub, n)
     env_states, obs = jax.vmap(env.reset)(reset_keys)
+    if observation_normalization:
+        # the initial reset observations are fed to the policy at t=0, so
+        # they belong in the normalization statistics (the reference updates
+        # stats on every observation the policy consumes)
+        stats = stats_update(stats, obs, mask=jnp.ones(n, dtype=bool))
 
     policy_proto = policy.initial_state()
     if policy_proto is None:
@@ -255,9 +260,10 @@ def run_vectorized_rollout(
         new_env_states, new_obs, rewards, dones = jax.vmap(env.step)(c.env_states, actions)
 
         steps_in_episode = c.steps_in_episode + 1
-        if episode_length is not None:
-            forced = steps_in_episode >= int(episode_length)
-            dones = dones | forced
+        # guaranteed truncation at max_t (gym TimeLimit semantics): even an
+        # env that never emits done internally ends its episode here, so
+        # per-episode score averaging stays well-defined
+        dones = dones | (steps_in_episode >= max_t)
 
         if decrease_rewards_by is not None:
             rewards = rewards - decrease_rewards_by
@@ -268,11 +274,6 @@ def run_vectorized_rollout(
 
         active_f = c.active
         scores = c.scores + jnp.where(active_f, rewards, 0.0)
-        new_stats = (
-            stats_update(c.stats, new_obs, mask=active_f)
-            if observation_normalization
-            else c.stats
-        )
 
         # auto-reset the envs that finished an episode (only matters while active)
         finished = dones & active_f
@@ -292,6 +293,14 @@ def run_vectorized_rollout(
 
         active = episodes_done < num_episodes
         total_steps = c.total_steps + jnp.sum(active_f.astype(jnp.int32))
+        # normalization statistics come from the observations the policy will
+        # actually consume next step: post-reset-selection obs, masked by the
+        # envs still running (ADVICE r1: not the pre-reset terminal obs)
+        new_stats = (
+            stats_update(c.stats, obs_next, mask=active)
+            if observation_normalization
+            else c.stats
+        )
 
         return Carry(
             env_states=env_states_next,

@@ -167,11 +167,15 @@ def _crowding_distances(evals, ranks, objective_sense):
         next_same = jnp.concatenate(
             [sorted_ranks[:-1] == sorted_ranks[1:], jnp.array([False])]
         )
-        obj_range = jnp.max(vals) - jnp.min(vals)
-        obj_range = jnp.where(obj_range <= 0, 1.0, obj_range)
+        # canonical NSGA-II normalizes each neighbor gap by the objective's
+        # min/max *within the front* (ADVICE r1), not the global range
+        front_max = jax.ops.segment_max(vals, ranks, num_segments=n)
+        front_min = jax.ops.segment_min(vals, ranks, num_segments=n)
+        front_range = front_max - front_min
+        front_range = jnp.where(front_range <= 0, 1.0, front_range)
         dist = jnp.where(
             prev_same & next_same,
-            (next_vals - prev_vals) / obj_range,
+            (next_vals - prev_vals) / front_range[sorted_ranks],
             big,
         )
         # scatter back to original order

@@ -26,18 +26,32 @@ def _xla_fallback(key, mu, sigma, num_directions):
     return jnp.stack([mu + eps, mu - eps], axis=1).reshape(2 * num_directions, mu.shape[-1])
 
 
+def _bits_to_unit_float(bits):
+    """Random bits -> float32 in [1, 2) via the mantissa trick. Mosaic has no
+    integer->float cast, and ``prng_random_bits`` has historically yielded
+    signed int32 on some jax versions — bitcasts sidestep both."""
+    bits = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+    mantissa = jax.lax.shift_right_logical(bits, jnp.uint32(9))
+    return jax.lax.bitcast_convert_type(
+        jax.lax.bitwise_or(mantissa, jnp.uint32(0x3F800000)), jnp.float32
+    )
+
+
 def _box_muller(bits_a, bits_b):
-    """Standard-normal noise from two uint32 draws (runs inside the kernel)."""
-    u1 = (bits_a.astype(jnp.float32) + 1.0) / 4294967296.0
-    u2 = bits_b.astype(jnp.float32) / 4294967296.0
+    """Standard-normal noise from two random-bit draws (runs inside the
+    kernel)."""
+    u1 = 2.0 - _bits_to_unit_float(bits_a)  # in (0, 1]: log never sees 0
+    u2 = _bits_to_unit_float(bits_b) - 1.0  # in [0, 1)
     return jnp.sqrt(-2.0 * jnp.log(u1)) * jnp.cos(_TWO_PI * u2)
 
 
-def _scale_interleave(eps, mu, sigma, out_ref):
-    """Fused scale + antithetic interleave into the output block."""
+def _scale_blocks(eps, mu, sigma, out_ref):
+    """Fused scale + antithetic blocks: plane 0 = mu+scaled, plane 1 =
+    mu-scaled (Mosaic cannot lower strided interleaved stores; the caller
+    interleaves the two contiguous planes with a free XLA reshape)."""
     scaled = eps * sigma
-    out_ref[0::2, :] = mu + scaled
-    out_ref[1::2, :] = mu - scaled
+    out_ref[0, :, :] = mu + scaled
+    out_ref[1, :, :] = mu - scaled
 
 
 def _pallas_kernel(seed_ref, mu_ref, sigma_ref, out_ref):
@@ -45,17 +59,17 @@ def _pallas_kernel(seed_ref, mu_ref, sigma_ref, out_ref):
     from jax.experimental.pallas import tpu as pltpu
 
     pltpu.prng_seed(seed_ref[0])
-    half, length = out_ref.shape[0] // 2, out_ref.shape[1]
+    half, length = out_ref.shape[1], out_ref.shape[2]
     bits_a = pltpu.prng_random_bits((half, length))
     bits_b = pltpu.prng_random_bits((half, length))
     eps = _box_muller(bits_a, bits_b)
-    _scale_interleave(eps, mu_ref[:], sigma_ref[:], out_ref)
+    _scale_blocks(eps, mu_ref[:], sigma_ref[:], out_ref)
 
 
 def _pallas_kernel_with_noise(eps_ref, mu_ref, sigma_ref, out_ref):
     # variant taking pre-drawn noise: used for interpret-mode testing of the
-    # fused scale/interleave structure on CPU
-    _scale_interleave(eps_ref[:], mu_ref[:], sigma_ref[:], out_ref)
+    # fused scale/antithetic structure on CPU
+    _scale_blocks(eps_ref[:], mu_ref[:], sigma_ref[:], out_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("num_solutions", "use_pallas", "interpret"))
@@ -81,13 +95,20 @@ def sample_symmetric_gaussian(
 
     from jax.experimental import pallas as pl
 
-    out_shape = jax.ShapeDtypeStruct((num_solutions, mu.shape[-1]), mu.dtype)
+    length = mu.shape[-1]
+    out_shape = jax.ShapeDtypeStruct((2, half, length), mu.dtype)
+
+    def interleave(planes):
+        # (2, half, L) -> interleaved (2*half, L): [mu+e0, mu-e0, mu+e1, ...]
+        return planes.transpose(1, 0, 2).reshape(num_solutions, length)
+
     if interpret:
         # the TPU PRNG primitives have no CPU lowering; draw the noise with
-        # the XLA PRNG and interpret only the fused scale/interleave
-        eps = jax.random.normal(key, (half, mu.shape[-1]), dtype=mu.dtype)
-        return pl.pallas_call(
+        # the XLA PRNG and interpret only the fused scale/antithetic part
+        eps = jax.random.normal(key, (half, length), dtype=mu.dtype)
+        planes = pl.pallas_call(
             _pallas_kernel_with_noise, out_shape=out_shape, interpret=True
         )(eps, mu, sigma)
+        return interleave(planes)
     seed = jax.random.randint(key, (1,), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-    return pl.pallas_call(_pallas_kernel, out_shape=out_shape)(seed, mu, sigma)
+    return interleave(pl.pallas_call(_pallas_kernel, out_shape=out_shape)(seed, mu, sigma))

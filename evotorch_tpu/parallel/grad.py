@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Callable, Optional, Type
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..tools.ranking import rank
@@ -32,6 +33,7 @@ def make_sharded_grad_estimator(
     ranking_method: str = "centered",
     mesh: Optional[Mesh] = None,
     axis_name: str = "pop",
+    with_aux: bool = False,
 ) -> Callable:
     """Build ``g(key, num_solutions, parameters) -> grads`` where the
     sample/evaluate/rank/grad pipeline runs sharded over the mesh and the
@@ -40,11 +42,50 @@ def make_sharded_grad_estimator(
 
     ``num_solutions`` is the *global* population size and must be divisible by
     the mesh axis size (and the local size must be even for symmetric
-    distributions)."""
+    distributions).
+
+    With ``with_aux=True`` the estimator returns ``(grads, aux)`` where
+    ``aux["mean_eval"]`` is the population-mean fitness (the pmean of the
+    shard-local means — what the reference's main process reconstructs from
+    the per-actor ``mean_eval`` entries, ``gaussian.py:246-272``)."""
     if mesh is None:
         mesh = default_mesh((axis_name,))
     n_shards = mesh.shape[axis_name]
     higher_is_better = {"max": True, "min": False}[objective_sense]
+
+    # one jitted shard_map program per (local popsize, static params): repeated
+    # calls must hit JAX's dispatch cache instead of retracing every generation
+    compiled: dict = {}
+
+    def _build(local_popsize: int, static_items: tuple):
+        static_params = dict(static_items)
+
+        def local(key, array_params):
+            parameters = {**array_params, **static_params}
+            my_key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+            samples = distribution_class._sample(my_key, parameters, local_popsize)
+            fitnesses = fitness_func(samples)
+            weights = rank(fitnesses, ranking_method, higher_is_better=higher_is_better)
+            grads = distribution_class._compute_gradients(
+                parameters, samples, weights, ranking_method
+            )
+            out = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, axis_name), grads
+            )
+            if with_aux:
+                aux = {"mean_eval": jax.lax.pmean(jnp.mean(fitnesses), axis_name)}
+                return out, aux
+            return out
+
+        return jax.jit(
+            jax.shard_map(
+                local,
+                mesh=mesh,
+                in_specs=(P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
 
     def estimator(key, num_solutions: int, parameters: dict):
         num_solutions = int(num_solutions)
@@ -63,26 +104,10 @@ def make_sharded_grad_estimator(
         }
         array_params = {k: v for k, v in parameters.items() if k not in static_params}
 
-        def local(key, array_params):
-            parameters = {**array_params, **static_params}
-            my_key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
-            samples = distribution_class._sample(my_key, parameters, local_popsize)
-            fitnesses = fitness_func(samples)
-            weights = rank(fitnesses, ranking_method, higher_is_better=higher_is_better)
-            grads = distribution_class._compute_gradients(
-                parameters, samples, weights, ranking_method
-            )
-            return jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, axis_name), grads
-            )
-
-        sharded = jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(P(), P()),
-            out_specs=P(),
-            check_vma=False,
-        )
-        return sharded(key, array_params)
+        cache_key = (local_popsize, tuple(sorted(static_params.items())))
+        fn = compiled.get(cache_key)
+        if fn is None:
+            fn = compiled[cache_key] = _build(local_popsize, cache_key[1])
+        return fn(key, array_params)
 
     return estimator

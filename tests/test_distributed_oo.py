@@ -1,0 +1,136 @@
+"""OO ``distributed=True`` semantics: shard-local ranking.
+
+The reference's distributed mode (``core.py:3156-3301`` +
+``algorithms/distributed/gaussian.py:199-272``) has each actor sample its own
+sub-population, rank **locally**, and compute local gradients; the main
+process averages them. These tests pin the TPU build to those exact
+statistics: ``Problem.sample_and_compute_gradients`` must rank per mesh shard
+(not globally) whenever a sharded evaluator is active.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evotorch_tpu import vectorized
+from evotorch_tpu.core import Problem
+from evotorch_tpu.algorithms import PGPE
+from evotorch_tpu.distributions import SymmetricSeparableGaussian
+from evotorch_tpu.tools.ranking import rank
+
+
+@vectorized
+def sphere(xs):
+    return jnp.sum(xs**2, axis=-1)
+
+
+def _make_problem(**kwargs):
+    return Problem("min", sphere, solution_length=6, initial_bounds=(-1, 1), **kwargs)
+
+
+def _dist_params():
+    return {
+        "mu": jnp.full((6,), 4.0),
+        "sigma": jnp.ones(6),
+        "divide_mu_grad_by": "num_directions",
+        "divide_sigma_grad_by": "num_directions",
+    }
+
+
+def _local_ranking_oracle(key, params, popsize, n_shards):
+    """Hand-rolled reference semantics: per-shard sample + local centered
+    ranking + local grads, equal-weight average (equal shard sizes)."""
+    local = popsize // n_shards
+    grads = []
+    all_samples, all_fits = [], []
+    for i in range(n_shards):
+        ki = jax.random.fold_in(key, i)
+        samples = SymmetricSeparableGaussian._sample(ki, params, local)
+        fits = sphere(samples)
+        weights = rank(fits, "centered", higher_is_better=False)
+        grads.append(
+            SymmetricSeparableGaussian._compute_gradients(params, samples, weights, "centered")
+        )
+        all_samples.append(samples)
+        all_fits.append(fits)
+    avg = {k: np.mean([np.asarray(g[k]) for g in grads], axis=0) for k in grads[0]}
+    return avg, jnp.concatenate(all_samples), jnp.concatenate(all_fits)
+
+
+def test_distributed_gradients_rank_locally():
+    p = _make_problem(num_actors="max")
+    dist = SymmetricSeparableGaussian(_dist_params())
+    key = jax.random.key(123)
+    results = p.sample_and_compute_gradients(dist, 16, ranking_method="centered", key=key)
+    assert len(results) == 1
+    got = results[0]
+    assert got["num_solutions"] == 16
+
+    oracle, all_samples, all_fits = _local_ranking_oracle(key, _dist_params(), 16, 8)
+    for k in ("mu", "sigma"):
+        assert np.allclose(np.asarray(got["gradients"][k]), oracle[k], atol=1e-5), k
+
+    # and local ranking is genuinely different from global ranking: the
+    # globally-ranked gradient over the same concatenated samples must differ
+    global_grads = dist.compute_gradients(
+        all_samples, all_fits, objective_sense="min", ranking_method="centered"
+    )
+    assert not np.allclose(
+        np.asarray(got["gradients"]["mu"]), np.asarray(global_grads["mu"]), atol=1e-6
+    )
+    assert np.isclose(got["mean_eval"], float(jnp.mean(all_fits)), atol=1e-4)
+
+
+def test_distributed_gradients_round_up_uneven_popsize():
+    p = _make_problem(num_actors="max")
+    dist = SymmetricSeparableGaussian(_dist_params())
+    # 20 does not divide over 8 shards; antithetic needs even local size
+    # -> local 2 everywhere, total rounds up to 16? no: ceil(20/8)=3 -> even 4 -> 32
+    results = p.sample_and_compute_gradients(dist, 20, ranking_method="centered")
+    assert results[0]["num_solutions"] == 32
+
+
+def test_pgpe_distributed_converges_on_sphere():
+    p = _make_problem(num_actors="max")
+    searcher = PGPE(
+        p,
+        popsize=64,
+        center_learning_rate=0.5,
+        stdev_learning_rate=0.1,
+        stdev_init=1.0,
+        center_init=jnp.full((6,), 3.0),
+        distributed=True,
+    )
+    searcher.run(40)
+    center = np.asarray(searcher.status["center"])
+    assert float(np.sum(center**2)) < 1.0
+    assert "mean_eval" in searcher.status
+
+
+def test_distributed_non_traceable_objective_falls_back():
+    # review regression: a host-side objective with num_actors must degrade
+    # to the single-program (global-ranking) path, not crash inside shard_map
+    import numpy as onp
+
+    @vectorized
+    def host_objective(xs):
+        return jnp.asarray(onp.sum(onp.asarray(xs) ** 2, axis=-1))
+
+    p = Problem("min", host_objective, solution_length=6, initial_bounds=(-1, 1), num_actors=4)
+    dist = SymmetricSeparableGaussian(_dist_params())
+    results = p.sample_and_compute_gradients(dist, 16, ranking_method="centered")
+    assert results[0]["num_solutions"] == 16
+    assert p._eval_mesh is None  # sharded machinery fully dropped
+    # and subsequent steps keep working on the fallback path
+    results = p.sample_and_compute_gradients(dist, 16, ranking_method="centered")
+    assert results[0]["num_solutions"] == 16
+
+
+def test_distributed_without_mesh_falls_back_to_single_program():
+    # no sharded evaluator: one global-ranking program, exactly one result
+    p = _make_problem()
+    dist = SymmetricSeparableGaussian(_dist_params())
+    key = jax.random.key(7)
+    results = p.sample_and_compute_gradients(dist, 16, ranking_method="centered", key=key)
+    assert len(results) == 1
+    assert results[0]["num_solutions"] == 16
