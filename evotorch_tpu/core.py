@@ -32,6 +32,7 @@ TPU-first redesign notes:
 from __future__ import annotations
 
 import math
+import pickle
 from typing import Any, Callable, Iterable, List, Optional, Union
 
 import jax
@@ -169,6 +170,8 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         self._eval_mesh = None  # mesh backing the sharded evaluator, if any
         self._eval_axis_name = "pop"
         self._sharded_grad_cache: dict = {}
+        self._host_pool = None  # multiprocessing pool for host-side objectives
+        self._is_main = True
 
         # solution stats (reference core.py:2334)
         self._store_solution_stats = True if store_solution_stats is None else bool(store_solution_stats)
@@ -239,8 +242,10 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
 
     @property
     def is_main(self) -> bool:
-        """Always True: there are no actor processes (SPMD replaces them)."""
-        return True
+        """False inside a host-pool worker process (reference actors'
+        ``is_main`` semantics); True in the main program — the SPMD mesh path
+        never leaves the main process."""
+        return getattr(self, "_is_main", True)
 
     def _process_bounds(self, bounds: Optional[BoundsPair]):
         if bounds is None:
@@ -345,8 +350,13 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
     def _evaluate_all(self, batch: "SolutionBatch"):
         """Single-program evaluation (reference ``core.py:2573``). When a
         sharded evaluator has been installed (``use_sharded_evaluation``),
-        the population axis is sharded over the mesh instead."""
+        the population axis is sharded over the mesh instead; when a host
+        pool exists (``num_actors`` with a non-traceable objective), the
+        batch fans out over worker processes."""
         self._resolve_num_actors_request()
+        if self._host_pool is not None and len(batch) > 0:
+            self._evaluate_with_host_pool(batch)
+            return
         use_subbatches = (
             self._num_subbatches is not None or self._subbatch_size is not None
         ) and self._sharded_evaluator is None
@@ -393,28 +403,64 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         self._evaluate_batch(batch)
 
     def _resolve_num_actors_request(self):
-        """Drop-in parity for ``num_actors`` (reference ``core.py:1302-1595``):
-        a request for N actors becomes a request for an N-device (or
-        all-device, for "max"/"num_devices"/"num_gpus") mesh over which the
-        population axis is sharded. Resolved lazily at first evaluation, like
-        the reference's lazy ``_parallelize``."""
-        if self._num_actors_requested is None or self._sharded_evaluator is not None:
+        """Drop-in parity for ``num_actors`` (reference ``core.py:1302-1595``),
+        resolved lazily at first evaluation like the reference's
+        ``_parallelize``. Two forms, picked by the objective's nature:
+
+        - jax-traceable ``@vectorized`` objective -> an N-device (or
+          all-device, for "max"/"num_devices"/"num_gpus") mesh over which the
+          population axis is sharded (zero processes, zero pickling);
+        - anything else (per-solution Python objectives, ``GymNE`` rollouts)
+          -> a pool of N worker *processes* each holding a problem clone, the
+          direct analog of the reference's Ray actor pool
+          (``core.py:1977-2052``).
+        """
+        if (
+            self._num_actors_requested is None
+            or self._sharded_evaluator is not None
+            or self._host_pool is not None
+        ):
             return
         request = self._num_actors_requested
         self._num_actors_requested = None  # resolve once
         if not self._vectorized or self._objective_func is None:
-            # no jax-pure batched objective to shard; warn instead of a
-            # silent no-op (subclasses like VecNE honor the request themselves)
-            from .tools.misc import set_default_logger_config
+            # per-solution Python objectives and subclass `_evaluate*`
+            # overrides (e.g. GymNE) -> worker processes; VecNE never gets
+            # here (it overrides _resolve_num_actors_request with its own
+            # sharded path)
+            import multiprocessing as mp
 
-            set_default_logger_config().warning(
-                "num_actors=%r has no effect for this problem type: sharded "
-                "evaluation needs a @vectorized objective function (or a "
-                "problem class with its own sharded path, e.g. VecNE)",
-                request,
-            )
+            if isinstance(request, str):
+                if request in ("max", "num_cpus", "num_devices", "num_gpus"):
+                    n = mp.cpu_count()
+                else:
+                    raise ValueError(f"Unrecognized num_actors request: {request!r}")
+            else:
+                n = int(request)
+            if n <= 1:
+                return
+            from .parallel.hostpool import HostEvaluatorPool
+
+            # per-worker seeds derived from the problem's PRNG chain, like the
+            # reference's per-actor derived seeds (core.py:133-141, 2043-2047)
+            seeds = np.asarray(
+                jax.random.randint(self.next_rng_key(), (n,), 0, 2**31 - 1)
+            ).tolist()
+            try:
+                self._host_pool = HostEvaluatorPool(self, n, seeds=seeds)
+            except (pickle.PicklingError, AttributeError, TypeError) as e:
+                # lambdas/closures pickle under Ray's cloudpickle but not under
+                # the stdlib; degrade to serial evaluation instead of crashing
+                from .tools.misc import set_default_logger_config
+
+                set_default_logger_config().warning(
+                    "num_actors=%r: the problem could not be pickled for "
+                    "worker processes (%s); evaluating serially instead. "
+                    "Define the objective at module level to enable the pool.",
+                    request,
+                    e,
+                )
             return
-        import jax
 
         if isinstance(request, str):
             if request in ("max", "num_devices", "num_gpus", "num_cpus"):
@@ -432,6 +478,45 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         self._sharded_evaluator = make_sharded_evaluator(self._objective_func, mesh=mesh)
         self._eval_mesh = mesh
         self._eval_axis_name = "pop"
+
+    def _evaluate_with_host_pool(self, batch: "SolutionBatch"):
+        """Split -> map over worker processes -> scatter back, with the sync
+        protocol around it (reference ``core.py:2583-2600`` + ``2313-2332``)."""
+        pool = self._host_pool
+        if self._num_subbatches is not None:
+            pieces = batch.split(min(int(self._num_subbatches), len(batch)))
+        elif self._subbatch_size is not None:
+            pieces = batch.split(max_size=int(self._subbatch_size))
+        else:
+            pieces = batch.split(min(pool.num_workers, len(batch)))
+        sync = self._make_sync_data_for_actors()
+        try:
+            evals, sync_back = pool.evaluate_pieces([p.values for p in pieces], sync)
+        except Exception:
+            # the pool shut itself down on failure; drop the dead handle so a
+            # later evaluate does not enqueue into a pool with no workers
+            self._host_pool = None
+            raise
+        for piece, piece_evals in zip(pieces, evals):
+            piece.set_evals(jnp.asarray(piece_evals, dtype=self._eval_dtype))
+        self._use_sync_data_from_actors(sync_back)
+
+    # --------------------- main<->worker sync protocol (reference 2239-2332)
+    def _make_sync_data_for_actors(self) -> Optional[dict]:
+        """State broadcast to every worker before an evaluation round
+        (e.g. obs-norm statistics). Default: nothing."""
+        return None
+
+    def _use_sync_data_from_main(self, data: dict):
+        """Worker-side: apply the broadcast state."""
+
+    def _make_sync_data_for_main(self) -> dict:
+        """Worker-side: state deltas to send home after an evaluation round
+        (e.g. obs-stat deltas, interaction counters). Default: nothing."""
+        return {}
+
+    def _use_sync_data_from_actors(self, data_list: List[dict]):
+        """Merge the per-worker deltas into the main problem."""
 
     def _evaluate_batch(self, batch: "SolutionBatch"):
         """Vectorized objective call or per-solution loop
@@ -759,7 +844,12 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
         return ProblemBoundEvaluator(self, obj_index=obj_index)
 
     def kill_actors(self):
-        """Compatibility no-op: there are no actors to kill."""
+        """Shut down the host evaluation pool, if one was spawned (reference
+        ``core.py:2650``-ish actor teardown). The mesh path has nothing to
+        kill."""
+        if self._host_pool is not None:
+            self._host_pool.shutdown()
+            self._host_pool = None
 
     @property
     def is_remote(self) -> bool:
@@ -775,8 +865,12 @@ class Problem(TensorMakerMixin, Serializable, RecursivePrintable):
     def _get_cloned_state(self, *, memo: dict) -> dict:
         state = {}
         for k, v in self.__dict__.items():
-            if k == "_sharded_evaluator":
-                state[k] = None  # compiled executables are not picklable
+            if k in ("_sharded_evaluator", "_eval_mesh", "_host_pool"):
+                # compiled executables, device meshes and worker processes
+                # are not picklable (and must not leak into clones/workers)
+                state[k] = None
+            elif k == "_sharded_grad_cache":
+                state[k] = {}
             else:
                 state[k] = deep_clone(v, memo=memo)
         return state

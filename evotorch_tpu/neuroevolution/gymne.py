@@ -242,6 +242,44 @@ class GymNE(NEProblem):
         with open(fname, "wb") as f:
             pickle.dump(payload, f)
 
+    # ------------------- host-pool sync protocol (reference gymne.py:524-573)
+    def _make_sync_data_for_actors(self):
+        if not self._observation_normalization:
+            return None
+        return {"obs_stats": self._obs_stats}
+
+    def _use_sync_data_from_main(self, data: dict):
+        # worker-side: adopt the broadcast stats and remember the baseline so
+        # only the *delta* collected during this round is sent home
+        import copy
+
+        self._obs_stats = copy.deepcopy(data["obs_stats"])
+        self._stats_at_sync = copy.deepcopy(self._obs_stats)
+
+    def _make_sync_data_for_main(self) -> dict:
+        data = {
+            "interactions": self._interaction_count,
+            "episodes": self._episode_count,
+        }
+        # worker-side counters reset after reporting: each round reports a delta
+        self._interaction_count = 0
+        self._episode_count = 0
+        if self._observation_normalization:
+            baseline = getattr(self, "_stats_at_sync", None)
+            if baseline is None:
+                data["obs_delta"] = self._obs_stats
+            else:
+                data["obs_delta"] = self._obs_stats.to_delta(baseline)
+        return data
+
+    def _use_sync_data_from_actors(self, data_list):
+        for data in data_list:
+            self._interaction_count += int(data.get("interactions", 0))
+            self._episode_count += int(data.get("episodes", 0))
+            delta = data.get("obs_delta")
+            if delta is not None:
+                self._obs_stats.update(delta)
+
     def _get_cloned_state(self, *, memo: dict) -> dict:
         state = super()._get_cloned_state(memo=memo)
         state["_gym_env"] = None  # env handles are not picklable

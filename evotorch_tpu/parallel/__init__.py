@@ -14,11 +14,16 @@ with XLA collectives over a ``jax.sharding.Mesh``:
 - obs-norm stat merging  -> ``psum`` of (count, sum, sumsq) — see
   ``neuroevolution.net.runningnorm``;
 - multi-host             -> ``jax.distributed.initialize`` over DCN.
+
+For objectives that are *not* jax-traceable (arbitrary Python fitness
+functions, classic gym rollouts), ``hostpool.HostEvaluatorPool`` provides the
+reference's actor-pool behavior with plain worker processes.
 """
 
 from .mesh import default_mesh, device_count, make_mesh
 from .evaluate import make_sharded_evaluator, shard_population
 from .grad import make_sharded_grad_estimator
+from .hostpool import HostEvaluatorPool
 from .distributed import init_distributed
 
 __all__ = [
@@ -28,5 +33,6 @@ __all__ = [
     "make_sharded_evaluator",
     "shard_population",
     "make_sharded_grad_estimator",
+    "HostEvaluatorPool",
     "init_distributed",
 ]

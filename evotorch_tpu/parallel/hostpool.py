@@ -1,0 +1,229 @@
+"""Host-side parallel evaluation: a multiprocessing actor pool.
+
+The TPU mesh path (``parallel/evaluate.py``) covers jax-traceable
+objectives; this module covers the reference's other use class — fanning an
+*arbitrary Python* fitness function (or a ``GymNE`` rollout) across worker
+processes (reference ``core.py:115-270`` ``EvaluationActor``,
+``core.py:1977-2052`` ``_parallelize`` + ``ActorPool``, ``core.py:2583-2600``
+``map_unordered`` scatter-back). Ray is replaced by ``multiprocessing``
+("spawn" start method: forking a process after JAX initialized its backend is
+unsafe), and the reference's main<->actor sync protocol
+(``core.py:2239-2332``) maps onto the same four Problem hooks it defines:
+``_make_sync_data_for_actors`` / ``_use_sync_data_from_main`` /
+``_make_sync_data_for_main`` / ``_use_sync_data_from_actors``.
+
+Workers force the CPU jax backend: host-side rollouts are numpy/gym work, and
+a worker must never contend for the (single-client) TPU.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import pickle
+import time
+import traceback
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["HostEvaluatorPool"]
+
+_STARTUP_TIMEOUT = 300.0
+
+_MAIN_GUARD_HINT = (
+    "HostEvaluatorPool was constructed inside a child process. This happens "
+    "when a script using num_actors is not wrapped in an "
+    "`if __name__ == '__main__':` guard: the 'spawn' start method re-imports "
+    "the main module in each worker, which would recursively spawn pools. "
+    "Wrap the script body in the guard (standard Python multiprocessing "
+    "requirement)."
+)
+
+
+def _worker_main(problem_bytes: bytes, seed: int, task_q, result_q):
+    # force the CPU backend BEFORE any jax device use: the axon PJRT plugin
+    # pins jax_platforms at interpreter startup and the TPU is single-client
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import jax.numpy as jnp
+
+    try:
+        problem = pickle.loads(problem_bytes)
+        problem._num_actors_requested = None  # workers never spawn sub-pools
+        problem._is_main = False
+        problem.manual_seed(seed)
+    except Exception:
+        result_q.put(("fatal", -1, traceback.format_exc()))
+        return
+    result_q.put(("ready", -1, None))
+
+    from ..core import SolutionBatch
+
+    while True:
+        msg = task_q.get()
+        if msg is None:
+            return
+        kind, idx, values, sync = msg
+        try:
+            if sync is not None:
+                problem._use_sync_data_from_main(sync)
+            if isinstance(values, np.ndarray):
+                values = jnp.asarray(values)
+            batch = SolutionBatch(problem, len(values), values=values)
+            problem.evaluate(batch)
+            result_q.put(
+                ("ok", idx, np.asarray(batch.evals), problem._make_sync_data_for_main())
+            )
+        except Exception:
+            result_q.put(("error", idx, traceback.format_exc()))
+
+
+class HostEvaluatorPool:
+    """N worker processes, each holding a pickled clone of the Problem
+    (exactly the reference's ``EvaluationActor`` arrangement,
+    ``core.py:115-270``); tasks are pulled from a shared queue, giving the
+    same dynamic load balancing as ``ActorPool.map_unordered``."""
+
+    def __init__(
+        self,
+        problem,
+        num_workers: int,
+        *,
+        seeds: Optional[Sequence[int]] = None,
+        timeout: Optional[float] = None,
+    ):
+        if mp.current_process().name != "MainProcess":
+            raise RuntimeError(_MAIN_GUARD_HINT)
+        self._num_workers = int(num_workers)
+        if self._num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        # optional wall-clock cap per evaluation round; None (default) relies
+        # on worker-liveness detection alone, like the reference's Ray path
+        self._timeout = timeout
+        ctx = mp.get_context("spawn")
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        problem_bytes = pickle.dumps(problem)
+        if seeds is None:
+            seeds = [None] * self._num_workers
+        self._procs = []
+        for i in range(self._num_workers):
+            seed = seeds[i] if seeds[i] is not None else i
+            p = ctx.Process(
+                target=_worker_main,
+                args=(problem_bytes, int(seed), self._task_q, self._result_q),
+                daemon=True,
+            )
+            p.start()
+            self._procs.append(p)
+        self._await_ready()
+
+    def _await_ready(self):
+        """Block until every worker finished bootstrapping (unpickled its
+        problem clone), failing fast — with the child traceback — if any died
+        on the way (e.g. an unpicklable objective, or a script missing its
+        ``__main__`` guard)."""
+        ready = 0
+        deadline = time.monotonic() + _STARTUP_TIMEOUT
+        while ready < self._num_workers:
+            try:
+                msg = self._result_q.get(timeout=1.0)
+            except Exception:
+                if time.monotonic() > deadline:
+                    self.shutdown()
+                    raise RuntimeError("host evaluation workers timed out during startup")
+                if not all(p.is_alive() for p in self._procs):
+                    self.shutdown()
+                    raise RuntimeError(
+                        "a host evaluation worker died during startup. "
+                        + _MAIN_GUARD_HINT
+                    )
+                continue
+            status, _, payload = msg
+            if status == "fatal":
+                self.shutdown()
+                raise RuntimeError(f"host evaluation worker failed to start:\n{payload}")
+            if status == "ready":
+                ready += 1
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    @property
+    def worker_pids(self) -> List[int]:
+        return [p.pid for p in self._procs]
+
+    def is_alive(self) -> bool:
+        return any(p.is_alive() for p in self._procs)
+
+    def evaluate_pieces(
+        self, pieces_values: Sequence, sync_data: Optional[dict]
+    ) -> Tuple[List[np.ndarray], List[dict]]:
+        """Evaluate the value arrays of each piece; returns per-piece eval
+        matrices (in piece order) and the unordered list of per-worker sync
+        payloads (one per piece). Any failure shuts the pool down, so stale
+        in-flight results can never bleed into a later round."""
+        try:
+            return self._evaluate_pieces(pieces_values, sync_data)
+        except Exception:
+            self.shutdown()
+            raise
+
+    def _evaluate_pieces(self, pieces_values, sync_data):
+        # prepare ALL transport payloads before enqueuing anything: a
+        # conversion error must not leave orphan tasks in flight
+        transport = []
+        for values in pieces_values:
+            if hasattr(values, "device"):  # jax array -> numpy for pickling
+                values = np.asarray(values)
+            transport.append(values)  # ObjectArray and ndarray both pickle
+        n = len(transport)
+        for i, v in enumerate(transport):
+            self._task_q.put(("eval", i, v, sync_data))
+        evals: List[Optional[np.ndarray]] = [None] * n
+        sync_back: List[dict] = []
+        received = 0
+        deadline = None if self._timeout is None else time.monotonic() + self._timeout
+        while received < n:
+            try:
+                msg = self._result_q.get(timeout=1.0)
+            except Exception as e:
+                if not all(p.is_alive() for p in self._procs):
+                    raise RuntimeError(
+                        "a host evaluation worker died mid-evaluation"
+                    ) from e
+                if deadline is not None and time.monotonic() > deadline:
+                    raise RuntimeError("host evaluation pool timed out") from e
+                continue
+            status, idx, *payload = msg
+            if status != "ok":
+                raise RuntimeError(f"host evaluation worker failed:\n{payload[-1]}")
+            evals[idx] = payload[0]
+            sync_back.append(payload[1])
+            received += 1
+            if deadline is not None:
+                deadline = time.monotonic() + self._timeout  # progress resets it
+        return evals, sync_back
+
+    def shutdown(self):
+        for _ in self._procs:
+            try:
+                self._task_q.put(None)
+            except Exception:
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self._procs = []
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
