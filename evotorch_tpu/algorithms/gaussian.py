@@ -23,6 +23,8 @@ import math
 from copy import deepcopy
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core import Problem, SolutionBatch
@@ -172,7 +174,9 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         return sigma
 
     def _get_mean_eval(self):
-        return self._mean_eval
+        # _mean_eval is kept as a device scalar (no sync in the hot loop);
+        # the host float materializes only when the status is actually read
+        return None if self._mean_eval is None else float(self._mean_eval)
 
     def _get_popsize(self):
         return 0 if self._population is None else len(self._population)
@@ -206,7 +210,7 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
             self._population = self._sample_population(self._popsize)
             problem.evaluate(self._population)
             return
-        first_count = problem.status.get("total_interaction_count", 0)
+        first_count = int(problem.status.get("total_interaction_count", 0))
         batches = []
         total_popsize = 0
         prev_made = -1
@@ -217,7 +221,7 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
             total_popsize += len(batch)
             if self._popsize_max is not None and total_popsize >= self._popsize_max:
                 break
-            interactions_made = problem.status.get("total_interaction_count", 0) - first_count
+            interactions_made = int(problem.status.get("total_interaction_count", 0)) - first_count
             if interactions_made > self._num_interactions:
                 break
             if "total_interaction_count" not in problem.status:
@@ -234,25 +238,24 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         if self._first_iter:
             self._first_iter = False
             self._fill_and_eval_pop()
-            self._mean_eval = float(
-                np.nanmean(np.asarray(self._population.evals[:, self._obj_index]))
-            )
+            self._mean_eval = jnp.nanmean(self._population.evals[:, self._obj_index])
             return
         pop = self._population
         samples = pop.values
         fitnesses = pop.evals[:, self._obj_index]
         obj_sense = self._problem.senses[self._obj_index]
-        grads = self._distribution.compute_gradients(
-            samples,
-            fitnesses,
-            objective_sense=obj_sense,
-            ranking_method=self._ranking_method if self._ranking_method is not None else "raw",
-        )
-        self._update_distribution(grads)
-        self._fill_and_eval_pop()
-        self._mean_eval = float(
-            np.nanmean(np.asarray(self._population.evals[:, self._obj_index]))
-        )
+        with jax.profiler.TraceAnnotation("evotorch_tpu.grad"):
+            grads = self._distribution.compute_gradients(
+                samples,
+                fitnesses,
+                objective_sense=obj_sense,
+                ranking_method=self._ranking_method if self._ranking_method is not None else "raw",
+            )
+        with jax.profiler.TraceAnnotation("evotorch_tpu.update"):
+            self._update_distribution(grads)
+        with jax.profiler.TraceAnnotation("evotorch_tpu.ask"):
+            self._fill_and_eval_pop()
+        self._mean_eval = jnp.nanmean(self._population.evals[:, self._obj_index])
 
     # ------------------------------------------------------------ distributed
     def _step_distributed(self):
@@ -268,15 +271,15 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         )
         grads_list = [r["gradients"] for r in results]
         nums = np.asarray([r["num_solutions"] for r in results], dtype=np.float64)
-        if self._popsize_weighted_grad_avg:
-            weights = nums / nums.sum()
-        else:
-            weights = np.full(len(results), 1.0 / len(results))
+        rel = nums / nums.sum()  # population-size weighting (host-side floats)
+        weights = rel if self._popsize_weighted_grad_avg else np.full(
+            len(results), 1.0 / len(results)
+        )
         avg = {}
         for k in grads_list[0]:
             avg[k] = sum(w * g[k] for w, g in zip(weights, grads_list))
-        mean_evals = np.asarray([r["mean_eval"] for r in results])
-        self._mean_eval = float(np.sum((nums / nums.sum()) * mean_evals))
+        # mean_eval stays a device scalar until the status is read
+        self._mean_eval = sum(w * r["mean_eval"] for w, r in zip(rel, results))
         self._update_distribution(avg)
 
     # --------------------------------------------------------------- updates

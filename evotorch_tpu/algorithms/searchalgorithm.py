@@ -9,108 +9,21 @@ Parity: reference ``algorithms/searchalgorithm.py`` — ``LazyReporter``
 from __future__ import annotations
 
 from datetime import datetime
+from functools import partial
 from typing import Optional
 
 import numpy as np
 
 from ..core import Problem
 from ..tools.hook import Hook
+from ..tools.lazyreporter import LazyReporter, LazyStatusDict
 
 __all__ = [
     "LazyReporter",
+    "LazyStatusDict",
     "SearchAlgorithm",
     "SinglePopulationAlgorithmMixin",
 ]
-
-
-class LazyReporter:
-    """Lazy, memoized status providers (reference ``searchalgorithm.py:34``).
-
-    Subclasses declare status items by passing ``name=getter_function`` pairs
-    to ``__init__``; each getter runs at most once per step."""
-
-    def __init__(self, **kwargs):
-        self._getters: dict = {}
-        self._computed: dict = {}
-        self.update_status_getters(kwargs)
-
-    def update_status_getters(self, getters: dict):
-        self._getters.update(getters)
-
-    # reference name (searchalgorithm.py uses add_status_getters)
-    add_status_getters = update_status_getters
-
-    def clear_status(self):
-        self._computed = {}
-
-    def update_status(self, additional_status: dict):
-        for k, v in additional_status.items():
-            if k not in self._getters:
-                self._computed[k] = v
-
-    def has_status_key(self, key: str) -> bool:
-        return key in self._computed or key in self._getters
-
-    def iter_status_keys(self):
-        seen = set()
-        for k in self._computed:
-            seen.add(k)
-            yield k
-        for k in self._getters:
-            if k not in seen:
-                yield k
-
-    def get_status_value(self, key: str):
-        if key in self._computed:
-            return self._computed[key]
-        if key in self._getters:
-            value = self._getters[key]()
-            self._computed[key] = value
-            return value
-        raise KeyError(key)
-
-    @property
-    def status(self) -> "LazyStatusDict":
-        return LazyStatusDict(self)
-
-
-class LazyStatusDict:
-    """Mapping view over a LazyReporter (reference ``searchalgorithm.py:180``)."""
-
-    def __init__(self, reporter: LazyReporter):
-        self._reporter = reporter
-
-    def __getitem__(self, key):
-        return self._reporter.get_status_value(key)
-
-    def __contains__(self, key):
-        return self._reporter.has_status_key(key)
-
-    def __iter__(self):
-        return self._reporter.iter_status_keys()
-
-    def __len__(self):
-        return sum(1 for _ in self._reporter.iter_status_keys())
-
-    def keys(self):
-        return list(iter(self))
-
-    def items(self):
-        for k in self:
-            yield k, self[k]
-
-    def values(self):
-        for k in self:
-            yield self[k]
-
-    def get(self, key, default=None):
-        try:
-            return self[key]
-        except KeyError:
-            return default
-
-    def __repr__(self):
-        return f"<status {self.keys()}>"
 
 
 class SearchAlgorithm(LazyReporter):
@@ -126,6 +39,35 @@ class SearchAlgorithm(LazyReporter):
         self._end_of_run_hook = Hook()
         self._steps_count = 0
         self._first_step_datetime: Optional[datetime] = None
+        self._problem_status_keys: tuple = ()
+
+    # ---- problem-status passthrough (lazy; lowest precedence) --------------
+    # The problem's status merges into the algorithm's WITHOUT materializing
+    # device-resident entries. Precedence: _computed (update_status results,
+    # incl. after-step hooks) > _getters (algorithm getters) > problem keys —
+    # so hooks can still override problem-reported values. Reads memoize into
+    # _computed, pinning the value for the rest of the step.
+    def get_status_value(self, key: str):
+        try:
+            return super().get_status_value(key)
+        except KeyError:
+            if key in self._problem_status_keys:
+                value = self._problem.get_status_value(key)
+                self._computed[key] = value
+                return value
+            raise
+
+    def has_status_key(self, key: str) -> bool:
+        return super().has_status_key(key) or key in self._problem_status_keys
+
+    def iter_status_keys(self):
+        seen = set()
+        for k in super().iter_status_keys():
+            seen.add(k)
+            yield k
+        for k in self._problem_status_keys:
+            if k not in seen:
+                yield k
 
     @property
     def problem(self) -> Problem:
@@ -184,21 +126,43 @@ class SearchAlgorithm(LazyReporter):
         step_seconds = time.perf_counter() - t0
         self._steps_count += 1
         self.update_status({"iter": self._steps_count, "step_seconds": step_seconds})
-        self.update_status(self._problem.status)
+        # refresh the lazy problem-status passthrough (see get_status_value)
+        self._problem_status_keys = tuple(self._problem.iter_status_keys())
         extra = self._after_step_hook.accumulate_dict()
         if extra:
             self.update_status(extra)
         if len(self._log_hook) >= 1:
             self._log_hook(dict(self.status.items()))
 
-    def run(self, num_generations: int, *, reset_first_step_datetime: bool = True):
-        """Run ``num_generations`` steps (reference ``searchalgorithm.py:409``)."""
+    def run(
+        self,
+        num_generations: int,
+        *,
+        reset_first_step_datetime: bool = True,
+        profile_dir: Optional[str] = None,
+    ):
+        """Run ``num_generations`` steps (reference ``searchalgorithm.py:409``).
+
+        ``profile_dir`` captures a ``jax.profiler`` device trace of the whole
+        run (SURVEY.md §5: the reference has no tracing; on TPU this is how
+        you see MXU/HBM utilization and host<->device gaps). View with
+        ``tensorboard --logdir <profile_dir>`` or xprof."""
         if reset_first_step_datetime:
             self.reset_first_step_datetime()
-        for _ in range(int(num_generations)):
-            self.step()
-            if self.is_terminated:
-                break
+
+        def _run():
+            for _ in range(int(num_generations)):
+                self.step()
+                if self.is_terminated:
+                    break
+
+        if profile_dir is not None:
+            import jax
+
+            with jax.profiler.trace(str(profile_dir)):
+                _run()
+        else:
+            _run()
         if len(self._end_of_run_hook) >= 1:
             self._end_of_run_hook(dict(self.status.items()))
 
