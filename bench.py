@@ -67,6 +67,11 @@ def main():
     # opt-in: bf16 changes the measured compute dtype, so keep the default
     # comparable with previously recorded f32 baselines
     compute_dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
+    # "budget" (default): fixed interaction budget per lane with auto-reset —
+    # every lane active on every step, so every computed env step is a
+    # genuine counted interaction. "episodes" reproduces the reference's
+    # idle-when-done masking (conservative counting; see net/vecrl.py).
+    eval_mode = os.environ.get("BENCH_EVAL_MODE", "budget")
 
     env_name = os.environ.get("BENCH_ENV", "humanoid")
     # BENCH_ENV_ARGS: JSON kwargs for the env factory (e.g. '{"n_links": 6}'
@@ -108,6 +113,7 @@ def main():
             num_episodes=1,
             episode_length=episode_length,
             compute_dtype=compute_dtype,
+            eval_mode=eval_mode,
         )
         state = pgpe_tell(state, values, result.scores)
         return state, result.total_steps, result.scores
@@ -149,6 +155,7 @@ def main():
                 "env_args": env_kwargs,
                 "popsize": popsize,
                 "episode_length": episode_length,
+                "eval_mode": eval_mode,
                 "compute_dtype": str(compute_dtype.__name__ if compute_dtype else "float32"),
                 "backend": "cpu-fallback" if use_cpu else "tpu",
             }

@@ -41,11 +41,26 @@ class Env:
 
     Both are pure functions of their inputs; vectorization over envs is plain
     ``jax.vmap``, and auto-reset is implemented by the rollout driver
-    (``neuroevolution.vecneproblem``) with ``jnp.where`` masking."""
+    (``neuroevolution.vecneproblem``) with ``jnp.where`` masking.
+
+    **Natively-batched envs** (``batched_native = True``) additionally provide
+
+    - ``batch_reset(keys) -> (states, obs)`` with ``obs`` ``(B, obs_dim)``
+    - ``batch_step(states, actions) -> (states, obs, rewards, dones)`` with
+      leading-batch ``(B, ...)`` actions/obs/rewards/dones
+    - ``batch_where(mask, a, b)`` — per-lane state selection (auto-reset)
+
+    and may lay out their *internal* state pytree however they like. The
+    rollout engine calls these instead of ``vmap(step)``. The point is TPU
+    register tiling: ``vmap`` puts the population axis leading, which leaves
+    tiny trailing dims (3, 4) in the 128-lane axis of every vector register
+    and every loop-carried buffer. A batched-native env keeps the population
+    in the minor axis (see ``rigidbody.py``) for >10x the throughput."""
 
     observation_space: Space
     action_space: Space
     max_episode_steps: Optional[int] = None
+    batched_native: bool = False
 
     @property
     def observation_size(self) -> int:

@@ -22,6 +22,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from ..tools.pytree import replace
 from .base import Env, EnvState, Space
 from .rigidbody import (
@@ -30,8 +32,12 @@ from .rigidbody import (
     capsule_inertia,
     joint_angles,
     joint_velocities,
+    joint_angles_batched,
+    joint_velocities_batched,
     physics_step,
+    physics_step_batched,
     sphere_penetrations,
+    sphere_penetrations_batched,
 )
 
 __all__ = ["Humanoid"]
@@ -153,6 +159,8 @@ class Humanoid(Env):
     """
 
     max_episode_steps = 1000
+    # the hot path: population-minor physics (rigidbody.py layout note)
+    batched_native = True
 
     def __init__(
         self,
@@ -181,6 +189,17 @@ class Humanoid(Env):
         na = self.sys.num_act
         self.action_space = Space(shape=(na,), lb=-jnp.ones(na), ub=jnp.ones(na))
         self.observation_space = Space(shape=(self._obs_dim(),))
+
+        # static selection matrix flattening per-joint axis components
+        # (nj, 3) -> the action-DOF order; batched _free_components is then a
+        # dense (na, nj*3) x (nj*3, B) matmul instead of a scatter
+        nj = self.sys.num_joints
+        idx = np.asarray(self.sys.act_index).reshape(-1)  # (nj*3,)
+        sel = np.zeros((na, nj * 3), dtype=np.float32)
+        for flat_pos, a in enumerate(idx):
+            if a < na:
+                sel[a, flat_pos] = 1.0
+        self._free_sel = jnp.asarray(sel)
 
     def _obs_dim(self) -> int:
         nb = self.sys.num_bodies
@@ -217,6 +236,95 @@ class Humanoid(Env):
                 feet,
             ]
         )
+
+    # -- batched-native protocol (population-minor state layout) -----------
+    def _batch_free_components(self, comps: jnp.ndarray) -> jnp.ndarray:
+        """``(nj, 3, B)`` axis components -> ``(na, B)`` action-DOF order."""
+        nj = self.sys.num_joints
+        return self._free_sel @ comps.reshape(nj * 3, -1)
+
+    def _batch_obs(self, st: BodyState) -> jnp.ndarray:
+        """Observation for a population state ``(nb, comp, B)`` -> ``(B, obs)``.
+        Field order matches :meth:`_obs` exactly."""
+        B = st.pos.shape[-1]
+        ja = self._batch_free_components(joint_angles_batched(self.sys, st))
+        jv = self._batch_free_components(joint_velocities_batched(self.sys, st))
+        obs = jnp.concatenate(
+            [
+                st.pos[0, 2:3, :],  # torso height (1, B)
+                st.quat[0],  # (4, B)
+                st.vel[0],  # (3, B)
+                st.ang[0],  # (3, B)
+                ja,  # (na, B)
+                jv,  # (na, B)
+                (st.pos[1:] - st.pos[:1]).reshape(-1, B),
+                (st.vel[1:] - st.vel[:1]).reshape(-1, B),
+                sphere_penetrations_batched(self.sys, st)[:4],  # feet (4, B)
+            ],
+            axis=0,
+        )
+        return obs.T
+
+    def batch_reset(self, keys):
+        """Reset ``B`` lanes at once; ``keys`` is a ``(B,)`` key array."""
+        B = keys.shape[0]
+        nb = self.sys.num_bodies
+        split = jax.vmap(lambda k: jax.random.split(k, 3))(keys)  # (B, 3) keys
+        noise = self.reset_noise_scale
+        vel = noise * jax.vmap(lambda k: jax.random.normal(k, (nb, 3)))(split[:, 1])
+        ang = noise * jax.vmap(lambda k: jax.random.normal(k, (nb, 3)))(split[:, 2])
+        st = BodyState(
+            pos=jnp.broadcast_to(self._default_pos[..., None], (nb, 3, B)),
+            quat=jnp.broadcast_to(
+                jnp.asarray([1.0, 0.0, 0.0, 0.0])[None, :, None], (nb, 4, B)
+            ),
+            vel=jnp.moveaxis(vel, 0, -1),
+            ang=jnp.moveaxis(ang, 0, -1),
+        )
+        state = EnvState(
+            obs_state=st, t=jnp.zeros((B,), jnp.int32), key=split[:, 0]
+        )
+        return state, self._batch_obs(st)
+
+    def batch_step(self, state: EnvState, actions):
+        """Step ``B`` lanes: ``actions`` ``(B, na)`` -> leading-batch outputs."""
+        actions = jnp.clip(actions, self.action_space.lb, self.action_space.ub)
+        a = actions.T  # (na, B): population-minor for the physics
+        st = physics_step_batched(self.sys, state.obs_state, a, self.dt, self.substeps)
+        t = state.t + 1
+
+        z = st.pos[0, 2, :]
+        lo, hi = self.healthy_z_range
+        unhealthy = (z < lo) | (z > hi)
+        done = unhealthy | (t >= self.max_episode_steps)
+
+        forward_vel = st.vel[0, 0, :]
+        ctrl_cost = self.ctrl_cost_weight * jnp.sum(a * a, axis=0)
+        reward = self.forward_reward_weight * forward_vel + self.alive_bonus - ctrl_cost
+        reward = jnp.where(unhealthy, reward - self.alive_bonus, reward)
+
+        return replace(state, obs_state=st, t=t), self._batch_obs(st), reward, done
+
+    def batch_where(self, mask, a: EnvState, b: EnvState) -> EnvState:
+        """Per-lane state select: lane i takes ``a`` where ``mask[i]`` else
+        ``b`` (the rollout driver's auto-reset). Field-explicit — the body
+        state is batch-trailing while ``t``/``key`` are batch-leading, so a
+        generic shape-sniffing tree_map would be ambiguous."""
+        obs_state = jax.tree_util.tree_map(
+            lambda x, y: jnp.where(mask[None, None, :], x, y),
+            a.obs_state,
+            b.obs_state,
+        )
+        t = jnp.where(mask, a.t, b.t)
+        ka, kb = a.key, b.key
+        if jnp.issubdtype(ka.dtype, jax.dtypes.prng_key):
+            kd = jnp.where(
+                mask[:, None], jax.random.key_data(ka), jax.random.key_data(kb)
+            )
+            key = jax.random.wrap_key_data(kd)
+        else:  # legacy raw uint32 keys, (B, 2)
+            key = jnp.where(mask[:, None], ka, kb)
+        return EnvState(obs_state=obs_state, t=t, key=key)
 
     # -- Env protocol ------------------------------------------------------
     def reset(self, key):

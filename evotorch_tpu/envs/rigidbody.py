@@ -49,9 +49,13 @@ __all__ = [
     "quat_integrate",
     "physics_substep",
     "physics_step",
+    "physics_step_batched",
     "joint_angles",
     "joint_velocities",
+    "joint_angles_batched",
+    "joint_velocities_batched",
     "sphere_penetrations",
+    "sphere_penetrations_batched",
     "capsule_inertia",
     "sphere_inertia",
 ]
@@ -191,146 +195,259 @@ class System(NamedTuple):
 
 
 # ---------------------------------------------------------------------------
-# Dynamics
+# Dynamics — population-minor ("batch-trailing") formulation
 # ---------------------------------------------------------------------------
+#
+# TPU vector registers are (8 sublanes x 128 lanes) tiles over the two
+# minor-most axes. Arrays shaped (popsize, nb, 3) — what `vmap` over a
+# single-env step produces — put 3 elements in the 128-lane axis: ~2% lane
+# utilization, and the rollout loop carry materializes that padding every
+# substep. The engine therefore computes natively on *batch-trailing* arrays
+# (nb, 3, B): the population axis fills the lanes, the component axis sits in
+# sublanes, and all body gathers/scatters become static row selections /
+# one-hot einsum contractions (dense matmuls). Measured on a v5e, this layout
+# is >10x faster than the vmap layout for the same loop-carried arithmetic.
+# The single-instance API (`physics_step` etc.) is the B=1 special case, so
+# there is exactly one implementation of the dynamics.
 
 
-def _joint_forces(sys: System, st: BodyState, actions: jnp.ndarray):
-    """Per-joint constraint + limit + actuation wrenches.
+def _bcross(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Cross product over the component axis -2 (``(..., 3, B)`` layout)."""
+    a0, a1, a2 = a[..., 0, :], a[..., 1, :], a[..., 2, :]
+    b0, b1, b2 = b[..., 0, :], b[..., 1, :], b[..., 2, :]
+    return jnp.stack(
+        (a1 * b2 - a2 * b1, a2 * b0 - a0 * b2, a0 * b1 - a1 * b0), axis=-2
+    )
 
-    Returns force/torque accumulators ``(nb, 3)``. All joints are processed as
-    one stacked computation: gather endpoint states, compute spring-damper
-    wrenches, scatter-add back onto the bodies.
-    """
+
+def _bquat_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    aw, ax, ay, az = a[..., 0, :], a[..., 1, :], a[..., 2, :], a[..., 3, :]
+    bw, bx, by, bz = b[..., 0, :], b[..., 1, :], b[..., 2, :], b[..., 3, :]
+    return jnp.stack(
+        (
+            aw * bw - ax * bx - ay * by - az * bz,
+            aw * bx + ax * bw + ay * bz - az * by,
+            aw * by - ax * bz + ay * bw + az * bx,
+            aw * bz + ax * by - ay * bx + az * bw,
+        ),
+        axis=-2,
+    )
+
+
+def _bquat_conj(q: jnp.ndarray) -> jnp.ndarray:
+    return q * jnp.asarray([1.0, -1.0, -1.0, -1.0], dtype=q.dtype)[:, None]
+
+
+def _bquat_rotate(q: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    qw = q[..., :1, :]
+    qv = q[..., 1:, :]
+    t = 2.0 * _bcross(qv, v)
+    return v + qw * t + _bcross(qv, t)
+
+
+def _bquat_rotate_inv(q: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    return _bquat_rotate(_bquat_conj(q), v)
+
+
+def _bquat_to_rotvec(q: jnp.ndarray) -> jnp.ndarray:
+    q = jnp.where(q[..., :1, :] < 0.0, -q, q)  # shortest rotation
+    w = q[..., 0, :]
+    xyz = q[..., 1:, :]
+    s = jnp.sqrt(jnp.sum(xyz * xyz, axis=-2))
+    angle = 2.0 * jnp.arctan2(s, w)
+    scale = jnp.where(s < 1e-7, 2.0, angle / jnp.maximum(s, 1e-12))
+    return xyz * scale[..., None, :]
+
+
+def _bquat_integrate(q: jnp.ndarray, omega_world: jnp.ndarray, h) -> jnp.ndarray:
+    zero = jnp.zeros_like(omega_world[..., :1, :])
+    omega_q = jnp.concatenate([zero, omega_world], axis=-2)
+    q_new = q + 0.5 * h * _bquat_mul(omega_q, q)
+    return q_new / jnp.sqrt(jnp.sum(q_new * q_new, axis=-2, keepdims=True))
+
+
+def _one_hot(idx: np.ndarray, n: int, dtype) -> jnp.ndarray:
+    """Static selection matrix (len(idx), n); body scatters become matmuls."""
+    return jnp.asarray(np.eye(n, dtype=np.float32)[np.asarray(idx)], dtype=dtype)
+
+
+def _scatter_bodies(hot: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Accumulate per-joint/per-sphere wrenches ``(nj, 3, B)`` onto bodies
+    ``(nb, 3, B)`` via a dense one-hot contraction (TPU scatters serialize;
+    a (nb, nj) x (nj, 3B) matmul does not)."""
+    return jnp.einsum("jb,jkB->bkB", hot, v)
+
+
+def _joint_forces_batched(sys: System, st: BodyState, actions: jnp.ndarray):
+    """Per-joint constraint + limit + actuation wrenches for a whole
+    population: state arrays ``(nb, comp, B)``, actions ``(num_act, B)``.
+    Returns force/torque accumulators ``(nb, 3, B)``."""
     p, c = sys.joint_parent, sys.joint_child
-    pq, cq = st.quat[p], st.quat[c]
+    pq, cq = st.quat[p], st.quat[c]  # (nj, 4, B) — static row gathers
     pp, cp = st.pos[p], st.pos[c]
     pv, cv = st.vel[p], st.vel[c]
     pw, cw = st.ang[p], st.ang[c]
 
     # --- positional constraint: pull the two anchor points together
-    ra = quat_rotate(pq, sys.anchor_p)  # world lever arms
-    rb = quat_rotate(cq, sys.anchor_c)
+    ra = _bquat_rotate(pq, sys.anchor_p[:, :, None])  # world lever arms
+    rb = _bquat_rotate(cq, sys.anchor_c[:, :, None])
     err = (cp + rb) - (pp + ra)
-    verr = (cv + jnp.cross(cw, rb)) - (pv + jnp.cross(pw, ra))
-    k_pos = sys.pos_k[:, None]
-    c_pos = sys.pos_c[:, None]
-    fj = -k_pos * err - c_pos * verr  # force on the child anchor
+    verr = (cv + _bcross(cw, rb)) - (pv + _bcross(pw, ra))
+    fj = -sys.pos_k[:, None, None] * err - sys.pos_c[:, None, None] * verr
 
     nb = st.pos.shape[0]
-    f = jnp.zeros((nb, 3), dtype=st.pos.dtype)
-    tau = jnp.zeros((nb, 3), dtype=st.pos.dtype)
-    f = f.at[c].add(fj).at[p].add(-fj)
-    tau = tau.at[c].add(jnp.cross(rb, fj)).at[p].add(jnp.cross(ra, -fj))
+    dtype = st.pos.dtype
+    c_hot = _one_hot(c, nb, dtype)
+    p_hot = _one_hot(p, nb, dtype)
+    inc = c_hot - p_hot  # force on child, reaction on parent
+    f = _scatter_bodies(inc, fj)
+    tau = _scatter_bodies(c_hot, _bcross(rb, fj)) - _scatter_bodies(
+        p_hot, _bcross(ra, fj)
+    )
 
     # --- angular: relative rotation decomposed onto the joint axes
-    q_rel = quat_mul(quat_conj(pq), cq)
-    phi = quat_to_rotvec(q_rel)  # (nj, 3), parent frame
-    w_rel = quat_rotate_inv(pq, cw - pw)
+    q_rel = _bquat_mul(_bquat_conj(pq), cq)
+    phi = _bquat_to_rotvec(q_rel)  # (nj, 3, B), parent frame
+    w_rel = _bquat_rotate_inv(pq, cw - pw)
 
     # components along the (orthonormal) joint axes; since the axes form a
     # complete basis, the whole angular response is expressed per component,
     # which lets every axis carry its own gain (a thigh's inertia about its
     # long axis is ~6x smaller than across it — shared gains would put the
     # twist axis past the explicit-integration stability bound)
-    phi_comp = jnp.einsum("jk,jak->ja", phi, sys.axes)  # (nj, 3)
-    w_comp = jnp.einsum("jk,jak->ja", w_rel, sys.axes)
+    phi_comp = jnp.einsum("jak,jkB->jaB", sys.axes, phi)  # (nj, 3, B)
+    w_comp = jnp.einsum("jak,jkB->jaB", sys.axes, w_rel)
 
-    over = jnp.maximum(phi_comp - sys.limit_hi, 0.0)
-    under = jnp.maximum(sys.limit_lo - phi_comp, 0.0)
-    act = jnp.concatenate([actions, jnp.zeros((1,), dtype=actions.dtype)])
-    drive = act[sys.act_index]  # (nj, 3); 0 for unactuated axes
-    actuated = (sys.gear > 0.0).astype(phi_comp.dtype)
+    limit_hi = sys.limit_hi[:, :, None]
+    limit_lo = sys.limit_lo[:, :, None]
+    gear = sys.gear[:, :, None]
+    over = jnp.maximum(phi_comp - limit_hi, 0.0)
+    under = jnp.maximum(limit_lo - phi_comp, 0.0)
+    act = jnp.concatenate(
+        [actions, jnp.zeros((1,) + actions.shape[1:], dtype=actions.dtype)]
+    )
+    drive = act[sys.act_index]  # (nj, 3, B); 0 for unactuated axes
+    actuated = (gear > 0.0).astype(dtype)
     if sys.act_mode == "position":
         # action in [-1, 1] maps to a target angle: 0 is the reference pose,
         # +/-1 the joint limits; a torque-clipped PD servo tracks it
-        target = jnp.where(drive >= 0.0, drive * sys.limit_hi, -drive * sys.limit_lo)
-        pd = sys.act_kp * (target - phi_comp) - sys.act_kd * w_comp
-        act_torque = actuated * jnp.clip(pd, -sys.gear, sys.gear)
+        target = jnp.where(drive >= 0.0, drive * limit_hi, -drive * limit_lo)
+        pd = sys.act_kp[:, :, None] * (target - phi_comp) - sys.act_kd[:, :, None] * w_comp
+        act_torque = actuated * jnp.clip(pd, -gear, gear)
     else:
-        act_torque = sys.gear * drive
-    locked = 1.0 - sys.free
+        act_torque = gear * drive
+    free = sys.free[:, :, None]
+    locked = 1.0 - free
     comp_torque = locked * (
-        -sys.ang_k * phi_comp - sys.ang_c * w_comp
-    ) + sys.free * (
-        sys.limit_k * (under - over)
-        - sys.tone_k * phi_comp
-        - sys.joint_damping * w_comp
+        -sys.ang_k[:, :, None] * phi_comp - sys.ang_c[:, :, None] * w_comp
+    ) + free * (
+        sys.limit_k[:, :, None] * (under - over)
+        - sys.tone_k[:, :, None] * phi_comp
+        - sys.joint_damping[:, :, None] * w_comp
         + act_torque
     )
-    tau_j = jnp.einsum("ja,jak->jk", comp_torque, sys.axes)
+    tau_j = jnp.einsum("jak,jaB->jkB", sys.axes, comp_torque)
 
-    tau_w = quat_rotate(pq, tau_j)  # parent frame -> world
-    tau = tau.at[c].add(tau_w).at[p].add(-tau_w)
+    tau_w = _bquat_rotate(pq, tau_j)  # parent frame -> world
+    tau = tau + _scatter_bodies(inc, tau_w)
     return f, tau
 
 
-def _contact_forces(sys: System, st: BodyState):
-    """Sphere-vs-ground penalty contacts with clamped viscous friction."""
+def _contact_forces_batched(sys: System, st: BodyState):
+    """Sphere-vs-ground penalty contacts with clamped viscous friction,
+    population-batched (``(ns, 3, B)`` intermediates)."""
     b = sys.sph_body
-    r_off = quat_rotate(st.quat[b], sys.sph_offset)
-    center = st.pos[b] + r_off
-    pen = sys.sph_radius - center[:, 2]
+    dtype = st.pos.dtype
+    r_off = _bquat_rotate(st.quat[b], sys.sph_offset[:, :, None])
+    pen = sys.sph_radius[:, None] - (st.pos[b][..., 2, :] + r_off[..., 2, :])
     in_contact = pen > 0.0
 
     # velocity of the lowest point of each sphere
-    rel = r_off - jnp.stack(
-        [jnp.zeros_like(sys.sph_radius), jnp.zeros_like(sys.sph_radius), sys.sph_radius],
-        axis=-1,
-    )
-    vc = st.vel[b] + jnp.cross(st.ang[b], rel)
+    e_z = jnp.asarray([0.0, 0.0, 1.0], dtype=dtype)[:, None]
+    rel = r_off - sys.sph_radius[:, None, None] * e_z
+    vc = st.vel[b] + _bcross(st.ang[b], rel)
 
-    fn = jnp.maximum(sys.contact_k * pen - sys.contact_c * vc[:, 2], 0.0)
+    fn = jnp.maximum(sys.contact_k * pen - sys.contact_c * vc[..., 2, :], 0.0)
     fn = jnp.where(in_contact, fn, 0.0)
 
-    vt = vc * jnp.asarray([1.0, 1.0, 0.0], dtype=vc.dtype)
-    vt_norm = jnp.linalg.norm(vt, axis=-1)
+    vt = vc * jnp.asarray([1.0, 1.0, 0.0], dtype=dtype)[:, None]
+    vt_norm = jnp.sqrt(vt[..., 0, :] ** 2 + vt[..., 1, :] ** 2)
     # clamped viscous friction: viscous at small slip, Coulomb cap mu*N above
     ft_mag = jnp.minimum(sys.friction_mu * fn, sys.tangent_damping * vt_norm)
-    ft = -vt * (ft_mag / jnp.maximum(vt_norm, 1e-6))[:, None]
-    fc = ft.at[:, 2].add(fn)
+    ft = -vt * (ft_mag / jnp.maximum(vt_norm, 1e-6))[..., None, :]
+    fc = ft + fn[..., None, :] * e_z
 
     nb = st.pos.shape[0]
-    f = jnp.zeros((nb, 3), dtype=st.pos.dtype).at[b].add(fc)
-    tau = jnp.zeros((nb, 3), dtype=st.pos.dtype).at[b].add(jnp.cross(rel, fc))
+    s_hot = _one_hot(b, nb, dtype)
+    f = _scatter_bodies(s_hot, fc)
+    tau = _scatter_bodies(s_hot, _bcross(rel, fc))
     return f, tau
 
 
-def physics_substep(sys: System, st: BodyState, actions: jnp.ndarray, h) -> BodyState:
-    """One semi-implicit Euler substep for all bodies."""
-    fj, tj = _joint_forces(sys, st, actions)
-    fc, tc = _contact_forces(sys, st)
-    f = fj + fc + sys.mass[:, None] * sys.gravity
+def physics_substep_batched(
+    sys: System, st: BodyState, actions: jnp.ndarray, h
+) -> BodyState:
+    """One semi-implicit Euler substep for a population: ``st`` arrays are
+    ``(nb, comp, B)``, ``actions`` ``(num_act, B)``."""
+    fj, tj = _joint_forces_batched(sys, st, actions)
+    fc, tc = _contact_forces_batched(sys, st)
+    mass = sys.mass[:, None, None]
+    f = fj + fc + mass * sys.gravity[None, :, None]
     tau = tj + tc
 
-    vel = st.vel + h * f / sys.mass[:, None]
+    vel = st.vel + h * f / mass
     # angular update in the body frame, where the inertia tensor is diagonal
-    w_body = quat_rotate_inv(st.quat, st.ang)
-    tau_body = quat_rotate_inv(st.quat, tau)
-    w_body = w_body + h * (
-        tau_body - jnp.cross(w_body, sys.inertia * w_body)
-    ) / sys.inertia
-    ang = quat_rotate(st.quat, w_body)
+    inertia = sys.inertia[:, :, None]
+    w_body = _bquat_rotate_inv(st.quat, st.ang)
+    tau_body = _bquat_rotate_inv(st.quat, tau)
+    w_body = w_body + h * (tau_body - _bcross(w_body, inertia * w_body)) / inertia
+    ang = _bquat_rotate(st.quat, w_body)
 
     # stability clamps: cap velocities so stiff-spring transients cannot blow up
     vel = jnp.clip(vel, -sys.max_vel, sys.max_vel)
     ang = jnp.clip(ang, -sys.max_ang, sys.max_ang)
 
     pos = st.pos + h * vel
-    quat = quat_integrate(st.quat, ang, h)
+    quat = _bquat_integrate(st.quat, ang, h)
     return BodyState(pos=pos, quat=quat, vel=vel, ang=ang)
+
+
+def physics_step_batched(
+    sys: System, st: BodyState, actions: jnp.ndarray, dt: float, substeps: int
+) -> BodyState:
+    """One control step = ``substeps`` substeps with the action held. Unrolled
+    (``substeps`` is static and small) so XLA can fuse across substeps."""
+    h = dt / substeps
+    for _ in range(int(substeps)):
+        st = physics_substep_batched(sys, st, actions, h)
+    return st
+
+
+# -- single-instance API: the B=1 special case ------------------------------
+
+
+def _to_batched(st: BodyState) -> BodyState:
+    return BodyState(*(x[..., None] for x in st))
+
+
+def _from_batched(st: BodyState) -> BodyState:
+    return BodyState(*(x[..., 0] for x in st))
+
+
+def physics_substep(sys: System, st: BodyState, actions: jnp.ndarray, h) -> BodyState:
+    """One semi-implicit Euler substep for all bodies (single instance)."""
+    out = physics_substep_batched(sys, _to_batched(st), actions[..., None], h)
+    return _from_batched(out)
 
 
 def physics_step(
     sys: System, st: BodyState, actions: jnp.ndarray, dt: float, substeps: int
 ) -> BodyState:
     """One control step = ``substeps`` physics substeps with the action held."""
-    h = dt / substeps
-
-    def body(_, s):
-        return physics_substep(sys, s, actions, h)
-
-    return jax.lax.fori_loop(0, substeps, body, st)
+    out = physics_step_batched(
+        sys, _to_batched(st), actions[..., None], dt, substeps
+    )
+    return _from_batched(out)
 
 
 # ---------------------------------------------------------------------------
@@ -338,26 +455,42 @@ def physics_step(
 # ---------------------------------------------------------------------------
 
 
-def joint_angles(sys: System, st: BodyState) -> jnp.ndarray:
-    """Rotation of each joint decomposed onto its axes, ``(nj, 3)``."""
+def joint_angles_batched(sys: System, st: BodyState) -> jnp.ndarray:
+    """Rotation of each joint decomposed onto its axes, ``(nj, 3, B)``."""
     pq = st.quat[sys.joint_parent]
     cq = st.quat[sys.joint_child]
-    phi = quat_to_rotvec(quat_mul(quat_conj(pq), cq))
-    return jnp.einsum("jk,jak->ja", phi, sys.axes)
+    phi = _bquat_to_rotvec(_bquat_mul(_bquat_conj(pq), cq))
+    return jnp.einsum("jak,jkB->jaB", sys.axes, phi)
+
+
+def joint_velocities_batched(sys: System, st: BodyState) -> jnp.ndarray:
+    """Relative angular velocity of each joint on its axes, ``(nj, 3, B)``."""
+    p, c = sys.joint_parent, sys.joint_child
+    w_rel = _bquat_rotate_inv(st.quat[p], st.ang[c] - st.ang[p])
+    return jnp.einsum("jak,jkB->jaB", sys.axes, w_rel)
+
+
+def sphere_penetrations_batched(sys: System, st: BodyState) -> jnp.ndarray:
+    """Ground penetration depth per collider sphere (``(ns, B)``, >= 0)."""
+    b = sys.sph_body
+    r_off = _bquat_rotate(st.quat[b], sys.sph_offset[:, :, None])
+    center_z = st.pos[b][..., 2, :] + r_off[..., 2, :]
+    return jnp.maximum(sys.sph_radius[:, None] - center_z, 0.0)
+
+
+def joint_angles(sys: System, st: BodyState) -> jnp.ndarray:
+    """Rotation of each joint decomposed onto its axes, ``(nj, 3)``."""
+    return joint_angles_batched(sys, _to_batched(st))[..., 0]
 
 
 def joint_velocities(sys: System, st: BodyState) -> jnp.ndarray:
     """Relative angular velocity of each joint on its axes, ``(nj, 3)``."""
-    p, c = sys.joint_parent, sys.joint_child
-    w_rel = quat_rotate_inv(st.quat[p], st.ang[c] - st.ang[p])
-    return jnp.einsum("jk,jak->ja", w_rel, sys.axes)
+    return joint_velocities_batched(sys, _to_batched(st))[..., 0]
 
 
 def sphere_penetrations(sys: System, st: BodyState) -> jnp.ndarray:
     """Ground penetration depth per collider sphere (``(ns,)``, clipped >=0)."""
-    b = sys.sph_body
-    center = st.pos[b] + quat_rotate(st.quat[b], sys.sph_offset)
-    return jnp.maximum(sys.sph_radius - center[:, 2], 0.0)
+    return sphere_penetrations_batched(sys, _to_batched(st))[..., 0]
 
 
 # ---------------------------------------------------------------------------

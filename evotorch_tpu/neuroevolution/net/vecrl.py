@@ -145,6 +145,7 @@ def _policy_to_action(raw, action_space, noise, clip: bool):
         "decrease_rewards_by",
         "action_noise_stdev",
         "compute_dtype",
+        "eval_mode",
     ),
 )
 def run_vectorized_rollout(
@@ -161,6 +162,7 @@ def run_vectorized_rollout(
     decrease_rewards_by: Optional[float] = None,
     action_noise_stdev: Optional[float] = None,
     compute_dtype=None,
+    eval_mode: str = "episodes",
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
 
@@ -174,7 +176,26 @@ def run_vectorized_rollout(
     its inputs for the forward pass — the MXU fast path; ES is robust to
     low-precision fitness since ranking is scale-free. Env dynamics, rewards
     and statistics stay in f32.
+
+    ``eval_mode`` selects the evaluation contract:
+
+    - ``"episodes"`` (the reference's ``VecGymNE`` semantics): each lane runs
+      exactly ``num_episodes`` episodes, then idles (masked) until every lane
+      is finished. The ``lax.while_loop`` exits as soon as all lanes are done,
+      but in the worst case the whole population waits on its longest
+      survivor — finished lanes burn compute producing nothing.
+    - ``"budget"``: each lane consumes a fixed interaction budget of
+      ``num_episodes * max_episode_steps`` steps, auto-resetting whenever an
+      episode ends; the score is the average episodic return over the budget
+      (completed episodes plus the fractional trailing episode). Every lane
+      is active on every step, so the whole program is one fixed-length
+      ``lax.fori_loop`` and 100% of computed env steps are genuine, counted
+      interactions — on accelerators this is the throughput-optimal contract
+      (it also gives low-variance fitness: constant compute per solution, no
+      survivorship skew). This is the flagship benchmark path.
     """
+    if eval_mode not in ("episodes", "budget"):
+        raise ValueError(f"eval_mode must be 'episodes' or 'budget', got {eval_mode!r}")
     n = params_batch.shape[0]
     if compute_dtype is not None:
         params_batch = params_batch.astype(compute_dtype)
@@ -183,9 +204,20 @@ def run_vectorized_rollout(
         max_t = min(max_t, int(episode_length))
     hard_cap = max_t * int(num_episodes) + 1
 
+    # natively-batched envs (population-minor internal layout; see
+    # envs/base.py) expose batch_reset/batch_step/batch_where, which the
+    # engine prefers over vmap — on TPU this is the difference between 3%
+    # and full lane utilization in the loop-carried physics state
+    batched_env = getattr(env, "batched_native", False)
+
+    def env_reset(keys):
+        if batched_env:
+            return env.batch_reset(keys)
+        return jax.vmap(env.reset)(keys)
+
     key, sub = jax.random.split(key)
     reset_keys = jax.random.split(sub, n)
-    env_states, obs = jax.vmap(env.reset)(reset_keys)
+    env_states, obs = env_reset(reset_keys)
     if observation_normalization:
         # the initial reset observations are fed to the policy at t=0, so
         # they belong in the normalization statistics (the reference updates
@@ -232,6 +264,8 @@ def run_vectorized_rollout(
         t_global=jnp.zeros((), dtype=jnp.int32),
     )
 
+    budget_mode = eval_mode == "budget"
+
     def cond(c: Carry):
         return jnp.any(c.active) & (c.t_global < hard_cap)
 
@@ -257,7 +291,14 @@ def run_vectorized_rollout(
             noise = action_noise_stdev * jax.random.normal(noise_key, raw.shape)
         actions = _policy_to_action(raw, env.action_space, noise, clip=True)
 
-        new_env_states, new_obs, rewards, dones = jax.vmap(env.step)(c.env_states, actions)
+        if batched_env:
+            new_env_states, new_obs, rewards, dones = env.batch_step(
+                c.env_states, actions
+            )
+        else:
+            new_env_states, new_obs, rewards, dones = jax.vmap(env.step)(
+                c.env_states, actions
+            )
 
         steps_in_episode = c.steps_in_episode + 1
         # guaranteed truncation at max_t (gym TimeLimit semantics): even an
@@ -279,20 +320,29 @@ def run_vectorized_rollout(
         finished = dones & active_f
         episodes_done = c.episodes_done + finished.astype(jnp.int32)
         reset_keys = jax.random.split(reset_key, n)
-        fresh_states, fresh_obs = jax.vmap(env.reset)(reset_keys)
+        fresh_states, fresh_obs = env_reset(reset_keys)
 
         def select(new, fresh):
             m = finished.reshape(finished.shape + (1,) * (new.ndim - 1))
             return jnp.where(m, fresh, new)
 
-        env_states_next = jax.tree_util.tree_map(select, new_env_states, fresh_states)
+        if batched_env:
+            env_states_next = env.batch_where(finished, fresh_states, new_env_states)
+        else:
+            env_states_next = jax.tree_util.tree_map(
+                select, new_env_states, fresh_states
+            )
         obs_next = select(new_obs, fresh_obs)
         steps_in_episode = jnp.where(finished, 0, steps_in_episode)
         if new_policy_states is not None:
             new_policy_states = reset_tensors(new_policy_states, finished)
 
-        active = episodes_done < num_episodes
-        total_steps = c.total_steps + jnp.sum(active_f.astype(jnp.int32))
+        if budget_mode:
+            active = active_f  # every lane runs its full budget
+            total_steps = c.total_steps + n
+        else:
+            active = episodes_done < num_episodes
+            total_steps = c.total_steps + jnp.sum(active_f.astype(jnp.int32))
         # normalization statistics come from the observations the policy will
         # actually consume next step: post-reset-selection obs, masked by the
         # envs still running (ADVICE r1: not the pre-reset terminal obs)
@@ -316,8 +366,19 @@ def run_vectorized_rollout(
             t_global=c.t_global + 1,
         )
 
-    final = jax.lax.while_loop(cond, body, carry)
-    mean_scores = final.scores / jnp.maximum(final.episodes_done, 1)
+    if budget_mode:
+        budget = max_t * int(num_episodes)
+        final = jax.lax.fori_loop(0, budget, lambda _, c: body(c), carry)
+        # average episodic return over the budget: completed episodes plus
+        # the fractional trailing one (exactly the episodic mean whenever the
+        # budget lands on an episode boundary)
+        episodes_frac = (
+            final.episodes_done + final.steps_in_episode.astype(jnp.float32) / max_t
+        )
+        mean_scores = final.scores / jnp.maximum(episodes_frac, 1.0 / max_t)
+    else:
+        final = jax.lax.while_loop(cond, body, carry)
+        mean_scores = final.scores / jnp.maximum(final.episodes_done, 1)
     return RolloutResult(
         scores=mean_scores,
         stats=final.stats,

@@ -50,6 +50,7 @@ class VecNE(NEProblem):
         action_noise_stdev: Optional[float] = None,
         num_episodes: int = 1,
         episode_length: Optional[int] = None,
+        eval_mode: str = "episodes",
         compute_dtype=None,
         initial_bounds=(-0.00001, 0.00001),
         seed: Optional[int] = None,
@@ -68,6 +69,15 @@ class VecNE(NEProblem):
         self._action_noise_stdev = action_noise_stdev
         self._num_episodes = int(num_episodes)
         self._episode_length = None if episode_length is None else int(episode_length)
+        # "episodes" = reference VecGymNE semantics (each lane runs
+        # num_episodes episodes then idles); "budget" = fixed interaction
+        # budget with auto-reset — the throughput-optimal contract where every
+        # computed step is a counted interaction (net/vecrl.py docstring)
+        if eval_mode not in ("episodes", "budget"):
+            raise ValueError(
+                f"eval_mode must be 'episodes' or 'budget', got {eval_mode!r}"
+            )
+        self._eval_mode = str(eval_mode)
         self._max_num_envs = None if max_num_envs is None else int(max_num_envs)
         # bfloat16 (etc.) policy compute for the MXU fast path
         self._compute_dtype = compute_dtype
@@ -139,6 +149,7 @@ class VecNE(NEProblem):
             decrease_rewards_by=self._decrease_rewards_by,
             action_noise_stdev=self._action_noise_stdev,
             compute_dtype=self._compute_dtype,
+            eval_mode=self._eval_mode,
         )
         return result
 
@@ -282,6 +293,7 @@ class VecNE(NEProblem):
                 decrease_rewards_by=self._decrease_rewards_by,
                 action_noise_stdev=self._action_noise_stdev,
                 compute_dtype=self._compute_dtype,
+                eval_mode=self._eval_mode,
             )
             # merge the per-shard stat deltas with a psum
             delta = jax.tree_util.tree_map(lambda new, old: new - old, result.stats, stats)

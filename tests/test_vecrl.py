@@ -190,3 +190,72 @@ def test_rollout_bf16_recurrent():
     )
     assert result.scores.shape == (3,)
     assert np.isfinite(np.asarray(result.scores)).all()
+
+
+# -- fixed-budget evaluation (the throughput-optimal contract) ----------------
+
+
+def test_rollout_budget_counts_every_step():
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    n = 6
+    params = jax.vmap(policy.init_parameters)(jax.random.split(jax.random.key(0), n))
+    stats = RunningNorm(env.observation_size).stats
+    result = run_vectorized_rollout(
+        env, policy, params, jax.random.key(1), stats,
+        num_episodes=1, episode_length=40, eval_mode="budget",
+    )
+    # every lane consumes exactly its budget: all computed steps are counted
+    assert int(result.total_steps) == n * 40
+    assert result.scores.shape == (n,)
+    assert np.isfinite(np.asarray(result.scores)).all()
+
+
+def test_rollout_budget_matches_episodes_on_full_horizon():
+    # Pendulum never terminates internally: each lane runs one truncated
+    # episode in both modes, so the two contracts must agree exactly
+    env = Pendulum()
+    policy = _linear_policy(env)
+    params = jnp.zeros((3, policy.parameter_count))
+    stats = RunningNorm(env.observation_size).stats
+    kw = dict(num_episodes=1, episode_length=25)
+    r_ep = run_vectorized_rollout(
+        env, policy, params, jax.random.key(0), stats, eval_mode="episodes", **kw
+    )
+    r_bu = run_vectorized_rollout(
+        env, policy, params, jax.random.key(0), stats, eval_mode="budget", **kw
+    )
+    assert np.allclose(np.asarray(r_ep.scores), np.asarray(r_bu.scores), rtol=1e-5)
+    assert int(r_ep.total_steps) == int(r_bu.total_steps) == 75
+    assert int(r_ep.total_episodes) == int(r_bu.total_episodes) == 3
+
+
+def test_rollout_budget_average_episodic_return():
+    # CartPole with a bad policy dies early and auto-resets: the budget-mode
+    # score is the average episodic return across those episodes, so it must
+    # sit inside the per-episode score range of the same policy
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    rng = np.random.default_rng(3)
+    params = jnp.asarray(rng.normal(size=(4, policy.parameter_count)) * 2.0, jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    result = run_vectorized_rollout(
+        env, policy, params, jax.random.key(5), stats,
+        num_episodes=1, episode_length=200, eval_mode="budget",
+    )
+    # several episodes fit in the budget for a falling policy
+    assert int(result.total_episodes) >= 4
+    # cartpole per-episode returns are in [1, 200] at this budget
+    assert float(jnp.min(result.scores)) >= 1.0
+    assert float(jnp.max(result.scores)) <= 200.0
+
+
+def test_rollout_budget_invalid_mode():
+    env = Pendulum()
+    policy = _linear_policy(env)
+    params = jnp.zeros((2, policy.parameter_count))
+    stats = RunningNorm(env.observation_size).stats
+    with pytest.raises(ValueError, match="eval_mode"):
+        run_vectorized_rollout(
+            env, policy, params, jax.random.key(0), stats, eval_mode="nope"
+        )
