@@ -15,8 +15,11 @@ is controlled by shardings (``jax.sharding``), not per-function device moves.
 from __future__ import annotations
 
 import functools
+import inspect
 import math
 from typing import Any, Callable, Optional
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -90,28 +93,95 @@ def expects_ndim(
     and a scalar stdev batch cleanly — the basis of *batched searches*
     (SURVEY.md §1, parallel API style 2).
 
-    Caveat: keyword arguments are bound statically (not vmapped); pass
-    anything that should batch as a positional argument with a declared ndim.
+    Reference-parity behaviors (``decorators.py:613-874``):
+
+    - **kwargs participate**: arguments passed by keyword are bound to their
+      positional slots via the function's signature, so declared ndims apply
+      regardless of call style. Only arguments landing in a ``**kwargs``
+      catch-all remain static.
+    - **scalar/numpy coercion with dtype inference**: python scalars, lists
+      and numpy arrays in declared slots are converted to jax arrays; float
+      values adopt the dtype of the first floating-point jax array among the
+      declared arguments (so a python-float stdev follows a bfloat16 center).
+
     PRNG keys passed through ``None`` slots are shared across batch lanes —
     key-consuming callers that need per-lane independence must split keys
     themselves (see ``operators.functional._apply_with_per_lane_keys``).
     """
 
     def decorator(fn: Callable) -> Callable:
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):  # builtins etc.: positional-only path
+            sig = None
+
+        def bind_to_positions(args, kwargs):
+            """-> (positional args covering the declared slots, static
+            kwargs)."""
+            if sig is None or not kwargs:
+                return list(args), dict(kwargs)
+            positional = [
+                p
+                for p in sig.parameters.values()
+                if p.kind
+                in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+            ]
+            bound = sig.bind(*args, **kwargs)
+            bound.apply_defaults()  # no gaps in the declared slots
+            out_args = []
+            for p in positional[: len(expected_ndims)]:
+                if p.name not in bound.arguments:
+                    break
+                out_args.append(bound.arguments.pop(p.name))
+            static = {}
+            for name, value in bound.arguments.items():
+                param = sig.parameters[name]
+                if param.kind == param.VAR_KEYWORD:
+                    static.update(value)
+                elif param.kind == param.VAR_POSITIONAL:
+                    if value:  # apply_defaults inserts an empty tuple
+                        raise TypeError(
+                            f"{fn.__name__}: expects_ndim does not support"
+                            " *args functions called past the declared slots"
+                        )
+                else:
+                    static[name] = value
+            return out_args, static
+
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
+            args, kwargs = bind_to_positions(args, kwargs)
             if len(args) > len(expected_ndims):
                 raise TypeError(
                     f"{fn.__name__}: got {len(args)} positional args, but "
                     f"expects_ndim declares only {len(expected_ndims)}"
                 )
+            # dtype inference target: the first floating jax array in a
+            # declared slot
+            float_dtype = None
+            for arg, nd in zip(args, expected_ndims):
+                if nd is None or not isinstance(arg, jax.Array):
+                    continue
+                if jnp.issubdtype(arg.dtype, jnp.floating):
+                    float_dtype = arg.dtype
+                    break
             arrs = []
             batch_shapes = []
             for arg, nd in zip(args, expected_ndims):
                 if nd is None:
                     arrs.append(arg)
                     continue
+                needs_coercion = not isinstance(arg, jax.Array) and isinstance(
+                    arg, (int, float, bool, list, tuple, np.ndarray, np.generic)
+                )
                 arr = jnp.asarray(arg)
+                if (
+                    needs_coercion
+                    and float_dtype is not None
+                    and jnp.issubdtype(arr.dtype, jnp.floating)
+                    and arr.dtype != float_dtype
+                ):
+                    arr = arr.astype(float_dtype)
                 extra = arr.ndim - nd
                 if extra < 0:
                     if allow_smaller_ndim:

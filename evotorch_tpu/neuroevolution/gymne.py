@@ -42,6 +42,7 @@ class GymNE(NEProblem):
         env_config: Optional[dict] = None,
         observation_normalization: bool = False,
         num_episodes: int = 1,
+        num_envs: Optional[int] = None,
         episode_length: Optional[int] = None,
         decrease_rewards_by: Optional[float] = None,
         alive_bonus_schedule: Optional[tuple] = None,
@@ -65,6 +66,12 @@ class GymNE(NEProblem):
         self._obs_stats = RunningStat()
         self._interaction_count = 0
         self._episode_count = 0
+        # num_envs > 1 turns on in-process vectorized evaluation: a
+        # SyncVectorEnv steps num_envs gym envs in lockstep with ONE batched
+        # device forward per timestep (the reference's VecGymNE-over-"gym::"
+        # path, vecgymne.py:744-916 + vecrl.py:1541-1912)
+        self._num_envs = None if num_envs is None else int(num_envs)
+        self._vec_env = None
 
         self._make_gym_env()  # early, so network constants are available
 
@@ -81,18 +88,22 @@ class GymNE(NEProblem):
         self.after_eval_hook.append(self._report_counters)
 
     # --------------------------------------------------------------- the env
-    def _make_gym_env(self):
-        if self._gym_env is not None:
-            return self._gym_env
+    def _build_one_env(self):
+        """Resolve the env spec (callable, plain name, or ``"gym::"`` string)
+        into a fresh env instance — shared by the serial env and the
+        vectorized lanes."""
         import gymnasium as gym
 
         if callable(self._env_spec):
-            self._gym_env = self._env_spec(**self._env_config)
-        else:
-            name = str(self._env_spec)
-            if name.startswith("gym::"):
-                name = name[len("gym::") :]
-            self._gym_env = gym.make(name, **self._env_config)
+            return self._env_spec(**self._env_config)
+        name = str(self._env_spec)
+        if name.startswith("gym::"):
+            name = name[len("gym::") :]
+        return gym.make(name, **self._env_config)
+
+    def _make_gym_env(self):
+        if self._gym_env is None:
+            self._gym_env = self._build_one_env()
         return self._gym_env
 
     @property
@@ -184,6 +195,41 @@ class GymNE(NEProblem):
         for _ in range(self._num_episodes):
             total += self._rollout(apply)["cumulative_reward"]
         return jnp.asarray(total / self._num_episodes)
+
+    # --------------------------------------- in-process vectorized evaluation
+    def _make_vector_env(self):
+        if self._vec_env is not None:
+            return self._vec_env
+        from .net.hostvecenv import SyncVectorEnv
+
+        self._vec_env = SyncVectorEnv(self._build_one_env, self._num_envs)
+        return self._vec_env
+
+    def _evaluate_batch(self, batch):
+        if self._num_envs is None or self._num_envs <= 1:
+            return super()._evaluate_batch(batch)
+        from .net.hostvecenv import run_host_vectorized_rollout
+
+        vec_env = self._make_vector_env()
+        values = jnp.asarray(batch.values)
+        n = values.shape[0]
+        scores = []
+        for start in range(0, n, self._num_envs):
+            result = run_host_vectorized_rollout(
+                vec_env,
+                self._policy,
+                values[start : start + self._num_envs],
+                num_episodes=self._num_episodes,
+                episode_length=self._episode_length,
+                obs_stats=self._obs_stats if self._observation_normalization else None,
+                decrease_rewards_by=self._decrease_rewards_by,
+                alive_bonus_schedule=self._alive_bonus_schedule,
+                action_noise_stdev=self._action_noise_stdev,
+            )
+            scores.append(result["scores"])
+            self._interaction_count += result["interactions"]
+            self._episode_count += result["episodes"]
+        batch.set_evals(jnp.asarray(np.concatenate(scores), dtype=jnp.float32))
 
     def run_solution(self, solution, *, num_episodes: int = 1, visualize: bool = False) -> float:
         """Deterministically run a solution (no stat updates)."""
@@ -283,4 +329,5 @@ class GymNE(NEProblem):
     def _get_cloned_state(self, *, memo: dict) -> dict:
         state = super()._get_cloned_state(memo=memo)
         state["_gym_env"] = None  # env handles are not picklable
+        state["_vec_env"] = None
         return state

@@ -1,0 +1,247 @@
+"""Host-side synchronous vector env + batched rollout for gym-API envs.
+
+Parity: reference ``net/vecrl.py:1541-1912`` (``SyncVectorEnv``) and the
+vectorized evaluation loop of ``vecgymne.py:744-916`` as applied to
+``"gym::"`` environments: N gymnasium environments stepped in lockstep on the
+host, eager auto-reset, per-env episode accounting with activity masking, and
+a *batched* policy forward — one device call per timestep for the whole lane
+block, instead of one per env (the reference's torch-policy-over-numpy-envs
+pattern, jax-side here).
+
+This is the capability class for environments that only exist as Python/gym
+code. The TPU-native throughput path remains ``VecNE`` over pure-JAX envs
+(``vecrl.run_vectorized_rollout``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .rl import alive_bonus_for_step
+from .vecrl import reset_tensors
+
+__all__ = ["SyncVectorEnv", "run_host_vectorized_rollout"]
+
+
+# module-level jitted forwards with the policy as a static arg: the jit cache
+# persists across rollout calls (a per-call jit wrapper would recompile every
+# chunk of every generation)
+@partial(jax.jit, static_argnames=("policy",))
+def _forward_stateless(policy, params, obs):
+    return jax.vmap(lambda p, o: policy(p, o))(params, obs)
+
+
+@partial(jax.jit, static_argnames=("policy",))
+def _forward_stateful(policy, params, obs, states):
+    return jax.vmap(policy)(params, obs, states)
+
+
+class SyncVectorEnv:
+    """Steps ``num_envs`` gymnasium environments in lockstep.
+
+    - ``reset()`` -> ``(num_envs, obs_dim)`` float32 observations.
+    - ``step(actions, active=None)`` -> ``(obs, rewards, dones)``; an env
+      whose episode ended is eagerly auto-reset (its returned observation is
+      the fresh reset observation, matching the reference's eager-autoreset
+      contract, ``vecrl.py:1541``); inactive lanes are skipped and yield NaN
+      dummy observations (the reference's exhausted-lane marker).
+    """
+
+    def __init__(
+        self,
+        env_fn: Union[Callable, Sequence[Callable]],
+        num_envs: Optional[int] = None,
+    ):
+        if callable(env_fn):
+            if num_envs is None:
+                raise ValueError("Give num_envs when env_fn is a single factory")
+            fns: List[Callable] = [env_fn] * int(num_envs)
+        else:
+            fns = list(env_fn)
+        self.envs = [fn() for fn in fns]
+        first = self.envs[0]
+        self.observation_space = first.observation_space
+        self.action_space = first.action_space
+        self._obs_dim = int(np.prod(first.observation_space.shape))
+
+    @property
+    def num_envs(self) -> int:
+        return len(self.envs)
+
+    @property
+    def is_discrete(self) -> bool:
+        return hasattr(self.action_space, "n")
+
+    def _flat_obs(self, obs) -> np.ndarray:
+        return np.asarray(obs, dtype=np.float32).reshape(-1)
+
+    def _reset_one(self, i: int) -> np.ndarray:
+        out = self.envs[i].reset()
+        if isinstance(out, tuple):  # modern gym API: (obs, info)
+            out = out[0]
+        return self._flat_obs(out)
+
+    def reset(self) -> np.ndarray:
+        return np.stack([self._reset_one(i) for i in range(self.num_envs)])
+
+    def step(self, actions, active: Optional[np.ndarray] = None):
+        n = self.num_envs
+        obs = np.full((n, self._obs_dim), np.nan, dtype=np.float32)
+        rewards = np.zeros(n, dtype=np.float32)
+        dones = np.zeros(n, dtype=bool)
+        for i in range(n):
+            if active is not None and not active[i]:
+                continue
+            result = self.envs[i].step(actions[i])
+            if len(result) == 5:  # modern API: obs, r, terminated, truncated, info
+                o, r, terminated, truncated, _ = result
+                done = bool(terminated) or bool(truncated)
+            else:  # classic API: obs, r, done, info
+                o, r, done, _ = result
+                done = bool(done)
+            rewards[i] = float(r)
+            dones[i] = done
+            obs[i] = self._reset_one(i) if done else self._flat_obs(o)
+        return obs, rewards, dones
+
+    def seed(self, seeds: Sequence[int]):
+        for env, s in zip(self.envs, seeds):
+            if hasattr(env, "reset"):
+                try:
+                    env.reset(seed=int(s))
+                except TypeError:
+                    pass  # classic API without seed kwarg
+
+    def close(self):
+        for env in self.envs:
+            if hasattr(env, "close"):
+                env.close()
+
+
+def run_host_vectorized_rollout(
+    vec_env: SyncVectorEnv,
+    policy,
+    params_batch,
+    *,
+    num_episodes: int = 1,
+    episode_length: Optional[int] = None,
+    obs_stats=None,
+    update_stats: bool = True,
+    decrease_rewards_by: float = 0.0,
+    alive_bonus_schedule: Optional[tuple] = None,
+    action_noise_stdev: Optional[float] = None,
+    rng: Optional[np.random.Generator] = None,
+) -> dict:
+    """Evaluate ``n <= num_envs`` policies, one per env lane, with a single
+    batched device forward per timestep (the vectorized-evaluation loop of
+    reference ``vecgymne.py:744-916`` over a host vector env).
+
+    ``policy`` is a :class:`FlatParamsPolicy`; ``params_batch`` is ``(n, L)``.
+    ``obs_stats`` is an optional ``RunningStat`` updated in place with every
+    observation the policies consume (when ``update_stats``) and used for
+    normalization. Returns ``{"scores", "interactions", "episodes"}``.
+    """
+    params_batch = jnp.asarray(params_batch)
+    n = params_batch.shape[0]
+    if n > vec_env.num_envs:
+        raise ValueError(f"{n} solutions > {vec_env.num_envs} env lanes")
+    rng = np.random.default_rng() if rng is None else rng
+
+    lanes = np.arange(n)
+    obs = vec_env.reset()[:n]
+    if obs_stats is not None and update_stats:
+        obs_stats.update(obs)
+
+    proto = policy.initial_state()
+    if proto is None:
+        states = None
+    else:
+        states = jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(leaf, (n,) + leaf.shape), proto
+        )
+
+    scores = np.zeros(n, dtype=np.float64)
+    episodes_done = np.zeros(n, dtype=np.int64)
+    steps_in_episode = np.zeros(n, dtype=np.int64)
+    active = np.ones(n, dtype=bool)
+    interactions = 0
+    act_space = vec_env.action_space
+    discrete = vec_env.is_discrete
+
+    while active.any():
+        norm_obs = obs
+        if obs_stats is not None and obs_stats.count >= 2:
+            norm_obs = obs_stats.normalize(obs).astype(np.float32)
+        norm_obs = np.nan_to_num(norm_obs)  # NaN dummy rows of inactive lanes
+        if states is None:
+            out, new_states = _forward_stateless(
+                policy, params_batch, jnp.asarray(norm_obs)
+            )
+        else:
+            out, new_states = _forward_stateful(
+                policy, params_batch, jnp.asarray(norm_obs), states
+            )
+        out = np.asarray(out)
+
+        if discrete:
+            actions = np.argmax(out, axis=-1)
+        else:
+            actions = out.astype(np.float64).reshape((n,) + act_space.shape)
+            if action_noise_stdev is not None:
+                actions = actions + rng.normal(size=actions.shape) * float(
+                    action_noise_stdev
+                )
+            actions = np.clip(actions, act_space.low, act_space.high)
+
+        # lanes beyond n (shorter final chunk) stay permanently inactive
+        pad = vec_env.num_envs - n
+        if pad:
+            actions = np.concatenate(
+                [actions, np.zeros((pad,) + actions.shape[1:], actions.dtype)]
+            )
+            full_active = np.concatenate([active, np.zeros(pad, dtype=bool)])
+        else:
+            full_active = active
+        new_obs, rewards, env_dones = vec_env.step(actions, active=full_active)
+        new_obs, rewards, env_dones = new_obs[:n], rewards[:n], env_dones[:n]
+        steps_in_episode[active] += 1
+        interactions += int(active.sum())
+        dones = env_dones.copy()
+        if episode_length is not None:
+            dones = dones | (active & (steps_in_episode >= int(episode_length)))
+
+        rewards = rewards - decrease_rewards_by
+        if alive_bonus_schedule is not None:
+            for i in lanes[active & ~dones]:
+                rewards[i] += float(
+                    alive_bonus_for_step(int(steps_in_episode[i]), alive_bonus_schedule)
+                )
+        scores[active] += rewards[active]
+
+        finished = dones & active
+        episodes_done[finished] += 1
+        steps_in_episode[finished] = 0
+        if new_states is not None:
+            new_states = reset_tensors(new_states, jnp.asarray(finished))
+        states = new_states
+        active = episodes_done < int(num_episodes)
+
+        # lanes truncated by episode_length need a manual reset — the env
+        # auto-resets only on its own terminal signal (env_dones)
+        for i in lanes[finished & active & ~env_dones]:
+            new_obs[i] = vec_env._reset_one(i)
+        obs = new_obs
+
+        if obs_stats is not None and update_stats and active.any():
+            obs_stats.update(obs[active])
+
+    return {
+        "scores": scores / np.maximum(episodes_done, 1),
+        "interactions": interactions,
+        "episodes": int(episodes_done.sum()),
+    }

@@ -177,9 +177,11 @@ class HostEvaluatorPool:
     def _evaluate_pieces(self, pieces_values, sync_data):
         # prepare ALL transport payloads before enqueuing anything: a
         # conversion error must not leave orphan tasks in flight
+        import jax
+
         transport = []
         for values in pieces_values:
-            if hasattr(values, "device"):  # jax array -> numpy for pickling
+            if isinstance(values, jax.Array):  # jax array -> numpy for pickling
                 values = np.asarray(values)
             transport.append(values)  # ObjectArray and ndarray both pickle
         n = len(transport)

@@ -61,6 +61,58 @@ class ObjectArray(Sequence):
             result[i] = v
         return result
 
+    @staticmethod
+    def from_numpy(ndarray: np.ndarray) -> "ObjectArray":
+        """New ObjectArray from a 1-D numpy object array
+        (reference ``objectarray.py:512``)."""
+        if ndarray.ndim != 1:
+            raise ValueError(f"Expected a 1-D array, got ndim={ndarray.ndim}")
+        return ObjectArray.from_values(ndarray)
+
+    # -- tensor-like introspection (reference objectarray.py:204-311) --------
+    @property
+    def shape(self) -> tuple:
+        return (len(self._data),)
+
+    def size(self, dim: Optional[int] = None):
+        """The shape tuple, or the size along ``dim`` (torch-style)."""
+        if dim is None:
+            return self.shape
+        if dim not in (0, -1):
+            raise IndexError(f"ObjectArray is 1-D; no dimension {dim}")
+        return len(self._data)
+
+    @property
+    def ndim(self) -> int:
+        return 1
+
+    def dim(self) -> int:
+        return 1
+
+    def numel(self) -> int:
+        return len(self._data)
+
+    @property
+    def device(self) -> str:
+        """Always host-side (reference ``objectarray.py:299``: always cpu) —
+        object dtype never lives in device HBM."""
+        return "cpu"
+
+    def repeat(self, *sizes: int) -> "ObjectArray":
+        """Tile the array (torch ``repeat`` semantics for a 1-D tensor:
+        exactly one repeat count; reference ``objectarray.py:244``)."""
+        if len(sizes) != 1:
+            raise ValueError(
+                "ObjectArray is 1-D: repeat expects exactly one repeat count"
+            )
+        (n,) = sizes
+        result = ObjectArray(len(self._data) * int(n))
+        for rep in range(int(n)):
+            base = rep * len(self._data)
+            for i, v in enumerate(self._data):
+                result._data[base + i] = v  # elements are immutable: share
+        return result
+
     # -- element access ------------------------------------------------------
     def __getitem__(self, i):
         if isinstance(i, slice):
@@ -96,12 +148,34 @@ class ObjectArray(Sequence):
         for i in range(len(self)):
             yield self._data[i]
 
+    def set_item(self, i, value, *, memo: Optional[dict] = None):
+        """Explicit-name form of ``self[i] = value``
+        (reference ``objectarray.py:344``)."""
+        del memo  # immutable storage: no cycle bookkeeping needed
+        self[i] = value
+
     # -- semantics -----------------------------------------------------------
-    def clone(self, *, memo: Optional[dict] = None) -> "ObjectArray":
+    def clone(
+        self, *, preserve_read_only: bool = False, memo: Optional[dict] = None
+    ) -> "ObjectArray":
+        if memo is None:
+            memo = {}
+        existing = memo.get(id(self))
+        if existing is not None:
+            return existing
         result = ObjectArray(len(self))
+        memo[id(self)] = result
         for i in range(len(self)):
             result._data[i] = mutable_copy(self._data[i])
+        if preserve_read_only and self._read_only:
+            result = result.get_read_only_view()
         return result
+
+    def __copy__(self) -> "ObjectArray":
+        return self.clone(preserve_read_only=True)
+
+    def __deepcopy__(self, memo: Optional[dict]) -> "ObjectArray":
+        return self.clone(preserve_read_only=True, memo=memo)
 
     def get_read_only_view(self) -> "ObjectArray":
         view = ObjectArray(slice_of=(self, slice(None)))
@@ -114,6 +188,15 @@ class ObjectArray(Sequence):
 
     def numpy(self) -> np.ndarray:
         return self._data.copy()
+
+    def storage_ptr(self) -> int:
+        """Address of the underlying buffer — identical for views sharing
+        storage (the reference's ``storage().data_ptr()`` shared-memory
+        check, ``objectarray.py:31-36, 479``)."""
+        base = self._data
+        while base.base is not None:
+            base = base.base
+        return base.__array_interface__["data"][0]
 
     def __eq__(self, other):
         if isinstance(other, ObjectArray):

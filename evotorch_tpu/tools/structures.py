@@ -6,17 +6,35 @@ Parity: reference ``tools/structures.py`` (2457 LoC) — ``CMemory``
 (``structures.py:2024``), ``do_where`` (``structures.py:33``). All contiguous
 tensors with masked updates, usable under ``vmap``/``jit``.
 
-TPU-first deviation: jax arrays are immutable, so the reference's in-place
-methods (``set_``, ``add_``, ``append_``, ...) here RETURN the updated
-structure (pytree dataclasses) instead of mutating; the trailing-underscore
-names are kept so reference code maps 1:1 after adding an assignment. Batch
-dimensions come from ``vmap`` (every method is per-instance and pure) rather
-than explicit batch shapes.
+Batching comes in two interchangeable forms, exactly as in the reference:
+
+- **explicit batch shapes** — ``create(..., batch_shape=(B,))`` allocates a
+  contiguous batch of structures; keys/values/``where`` masks then carry the
+  batch shape on the left and every element addresses its own block;
+- **vmap** — an unbatched structure is a pytree of arrays, so ``jax.vmap``
+  over a stacked structure provides the same semantics (this is what the
+  reference's ``expects_ndim`` machinery emulates; JAX gives it natively).
+
+TPU-first deviations (documented, deliberate):
+
+- jax arrays are immutable, so the reference's in-place methods (``set_``,
+  ``add_``, ``append_``, ...) here RETURN the updated structure (pytree
+  dataclasses) instead of mutating; the trailing-underscore names are kept so
+  reference code maps 1:1 after adding an assignment.
+- the reference's ``verify`` flag raises on invalid keys eagerly; under jit
+  nothing can raise data-dependently, so invalid keys are always handled the
+  masked way (ignored on write, ``default``-filled on read) — the
+  reference's ``verify=False`` behavior.
+- ``CBag`` keeps per-key *counts* instead of a shuffled slot array: sampling
+  a random present element and decrementing its count IS sampling without
+  replacement, with identical distribution, in O(num_keys) fully-vectorized
+  work and without carrying a PRNG state inside the structure (keys are
+  passed explicitly, the JAX way).
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,122 +54,397 @@ def do_where(mask, a: Any, b: Any) -> Any:
     return jax.tree_util.tree_map(pick, a, b)
 
 
+def _open_grid(batch_shape: tuple) -> tuple:
+    """ogrid-style index arrays that broadcast to ``batch_shape`` (used to
+    make every batch element address its own block in one gather/scatter)."""
+    nb = len(batch_shape)
+    out = []
+    for i, d in enumerate(batch_shape):
+        shape = [1] * nb
+        shape[i] = d
+        out.append(jnp.arange(d).reshape(shape))
+    return tuple(out)
+
+
+def _as_tuple(x, n: int, what: str) -> tuple:
+    if isinstance(x, (tuple, list)):
+        if len(x) != n:
+            raise ValueError(f"{what} must have {n} element(s), got {x!r}")
+        return tuple(int(v) for v in x)
+    return (int(x),) * n
+
+
 @pytree_dataclass
 class CMemory:
     """Batched key -> tensor memory with masked updates
-    (reference ``structures.py:60``). Keys are integers in ``[0, num_keys)``."""
+    (reference ``structures.py:60-786``).
 
-    data: jnp.ndarray  # (num_keys, *value_shape)
+    Keys are integers in ``[key_offset, key_offset + num_keys)`` — or, with a
+    tuple-valued ``num_keys``, tuples of integers addressing a multi-dim key
+    space. With a ``batch_shape``, the object is a contiguous batch of
+    memories: keys, values and ``where`` masks carry the batch shape on the
+    left and each batch element reads/writes its own block.
+    """
+
+    data: jnp.ndarray  # (*batch_shape, *key_shape, *value_shape)
+    batch_ndim: int = static_field(default=0)
+    key_ndim: int = static_field(default=1)
+    key_offset: Optional[tuple] = static_field(default=None)
 
     @staticmethod
-    def create(num_keys: int, *value_shape: int, dtype=jnp.float32, fill: float = 0.0) -> "CMemory":
+    def create(
+        num_keys,
+        *value_shape: int,
+        dtype=jnp.float32,
+        fill: float = 0.0,
+        batch_shape: tuple = (),
+        key_offset=None,
+    ) -> "CMemory":
+        if isinstance(num_keys, (tuple, list)):
+            key_shape = tuple(int(n) for n in num_keys)
+        else:
+            key_shape = (int(num_keys),)
+        batch_shape = tuple(int(b) for b in batch_shape)
+        offset = (
+            None
+            if key_offset is None
+            else _as_tuple(key_offset, len(key_shape), "key_offset")
+        )
+        shape = batch_shape + key_shape + tuple(int(s) for s in value_shape)
         return CMemory(
-            data=jnp.full((int(num_keys),) + tuple(int(s) for s in value_shape), fill, dtype=dtype)
+            data=jnp.full(shape, fill, dtype=dtype),
+            batch_ndim=len(batch_shape),
+            key_ndim=len(key_shape),
+            key_offset=offset,
         )
 
+    # ------------------------------------------------------------ properties
     @property
-    def num_keys(self) -> int:
-        return self.data.shape[0]
+    def batch_shape(self) -> tuple:
+        return self.data.shape[: self.batch_ndim]
+
+    @property
+    def is_batched(self) -> bool:
+        return self.batch_ndim > 0
+
+    @property
+    def key_shape(self) -> tuple:
+        return self.data.shape[self.batch_ndim : self.batch_ndim + self.key_ndim]
+
+    @property
+    def num_keys(self):
+        ks = self.key_shape
+        return ks[0] if self.key_ndim == 1 else ks
 
     @property
     def value_shape(self) -> tuple:
-        return self.data.shape[1:]
+        return self.data.shape[self.batch_ndim + self.key_ndim :]
 
+    @property
+    def value_ndim(self) -> int:
+        return len(self.value_shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    # ------------------------------------------------------------ addressing
+    def _normalize_keys(self, key) -> Tuple[tuple, jnp.ndarray]:
+        """-> (per-dim key arrays broadcast to batch_shape, validity mask)."""
+        ks = self.key_shape
+        kd = self.key_ndim
+        if kd > 1:
+            if isinstance(key, (tuple, list)):
+                parts = [jnp.asarray(k) for k in key]
+                if len(parts) != kd:
+                    raise ValueError(
+                        f"Expected {kd} key components, got {len(parts)}"
+                    )
+            else:
+                arr = jnp.asarray(key)  # trailing dim = key dims
+                parts = [arr[..., i] for i in range(kd)]
+        else:
+            parts = [jnp.asarray(key)]
+        if self.key_offset is not None:
+            parts = [p - o for p, o in zip(parts, self.key_offset)]
+        # keys broadcast against the batch shape, and may carry EXTRA leading
+        # dims — an unbatched memory indexed with an array of keys gathers
+        # (the reference's plain multi-element indexing), and a batched one
+        # accepts (K, *batch_shape) key stacks
+        common = self.batch_shape
+        for p in parts:
+            common = jnp.broadcast_shapes(common, p.shape)
+        parts = [jnp.broadcast_to(p, common) for p in parts]
+        valid = jnp.ones(common, dtype=bool)
+        for p, d in zip(parts, ks):
+            valid = valid & (p >= 0) & (p < d)
+        return tuple(parts), valid
+
+    def _address(self, parts: tuple) -> tuple:
+        clipped = tuple(
+            jnp.clip(p, 0, d - 1) for p, d in zip(parts, self.key_shape)
+        )
+        return _open_grid(self.batch_shape) + clipped
+
+    # ------------------------------------------------------------ read/write
     def get(self, key, default=None) -> jnp.ndarray:
-        key = jnp.asarray(key)
-        value = self.data[key]
+        parts, valid = self._normalize_keys(key)
+        value = self.data[self._address(parts)]
         if default is not None:
-            valid = (key >= 0) & (key < self.num_keys)
-            value = do_where(valid, value, jnp.broadcast_to(jnp.asarray(default, self.data.dtype), value.shape))
+            value = do_where(
+                valid,
+                value,
+                jnp.broadcast_to(jnp.asarray(default, self.data.dtype), value.shape),
+            )
         return value
 
     def __getitem__(self, key) -> jnp.ndarray:
         return self.get(key)
 
-    def _masked_update(self, key, new_value, where) -> "CMemory":
-        key = jnp.asarray(key)
-        new_value = jnp.broadcast_to(jnp.asarray(new_value, self.data.dtype), self.value_shape)
-        if where is None:
-            return replace(self, data=self.data.at[key].set(new_value))
-        current = self.data[key]
-        masked = do_where(jnp.asarray(where), new_value, current)
-        return replace(self, data=self.data.at[key].set(masked))
+    def _apply(self, key, op, value, where) -> "CMemory":
+        parts, valid = self._normalize_keys(key)
+        idx = self._address(parts)
+        current = self.data[idx]
+        value = jnp.broadcast_to(jnp.asarray(value, self.data.dtype), current.shape)
+        new = op(current, value)
+        mask = valid
+        if where is not None:
+            mask = mask & jnp.broadcast_to(jnp.asarray(where), valid.shape)
+        new = do_where(mask, new, current)
+        return replace(self, data=self.data.at[idx].set(new))
 
     def set_(self, key, value, where=None) -> "CMemory":
-        """Masked overwrite (reference ``structures.py:300``-ish ``set_``)."""
-        return self._masked_update(key, value, where)
+        """Masked overwrite (reference ``structures.py:555``)."""
+        return self._apply(key, lambda cur, v: v, value, where)
 
     def add_(self, key, value, where=None) -> "CMemory":
-        return self._masked_update(key, self.data[jnp.asarray(key)] + jnp.asarray(value, self.data.dtype), where)
+        return self._apply(key, lambda cur, v: cur + v, value, where)
 
     def subtract_(self, key, value, where=None) -> "CMemory":
-        return self._masked_update(key, self.data[jnp.asarray(key)] - jnp.asarray(value, self.data.dtype), where)
+        return self._apply(key, lambda cur, v: cur - v, value, where)
 
     def multiply_(self, key, value, where=None) -> "CMemory":
-        return self._masked_update(key, self.data[jnp.asarray(key)] * jnp.asarray(value, self.data.dtype), where)
+        return self._apply(key, lambda cur, v: cur * v, value, where)
 
     def divide_(self, key, value, where=None) -> "CMemory":
-        return self._masked_update(key, self.data[jnp.asarray(key)] / jnp.asarray(value, self.data.dtype), where)
+        return self._apply(key, lambda cur, v: cur / v, value, where)
+
+    def add_circular_(self, key, value, mod, where=None) -> "CMemory":
+        """``slot = (slot + value) % mod``, masked
+        (reference ``structures.py:606``)."""
+        mod = jnp.asarray(mod, self.data.dtype)
+        return self._apply(key, lambda cur, v: (cur + v) % mod, value, where)
 
 
 @pytree_dataclass
 class CDict:
-    """CMemory with a static hashable-key namespace
-    (reference ``structures.py:892``)."""
+    """Batchable dictionary: a :class:`CMemory` plus per-key existence flags
+    (reference ``structures.py:892``).
+
+    Two key modes are supported:
+
+    - **integer keys** (the reference's semantics): ``CDict.create(num_keys,
+      *value_shape)`` — keys are integers (or tuples, with tuple-valued
+      ``num_keys``), traceable under jit, and the dict can carry an explicit
+      ``batch_shape``;
+    - **named keys** (a host-side convenience this framework adds):
+      ``CDict.create(["alpha", "beta"], *value_shape)`` — a static hashable
+      namespace resolved to slot indices at trace time.
+
+    ``set_`` flags existence; the arithmetic updates (``add_`` etc.) modify
+    values but do not change existence (reference semantics); ``get`` with a
+    ``default`` returns the default for missing keys; ``clear`` resets
+    existence flags (not values), optionally masked per batch element.
+    """
 
     memory: CMemory
-    keys: tuple = static_field()
+    exist: jnp.ndarray  # (*batch_shape, *key_shape) bool
+    names: Optional[tuple] = static_field(default=None)
 
     @staticmethod
-    def create(keys, *value_shape: int, dtype=jnp.float32, fill: float = 0.0) -> "CDict":
-        keys = tuple(keys)
-        return CDict(
-            memory=CMemory.create(len(keys), *value_shape, dtype=dtype, fill=fill),
-            keys=keys,
+    def create(
+        keys_or_num_keys,
+        *value_shape: int,
+        dtype=jnp.float32,
+        fill: float = 0.0,
+        batch_shape: tuple = (),
+        key_offset=None,
+    ) -> "CDict":
+        names = None
+        num_keys = keys_or_num_keys
+        if not isinstance(keys_or_num_keys, int) and not (
+            isinstance(keys_or_num_keys, (tuple, list))
+            and all(isinstance(k, int) for k in keys_or_num_keys)
+        ):
+            names = tuple(keys_or_num_keys)
+            num_keys = len(names)
+        memory = CMemory.create(
+            num_keys,
+            *value_shape,
+            dtype=dtype,
+            fill=fill,
+            batch_shape=batch_shape,
+            key_offset=key_offset,
         )
+        exist = jnp.zeros(memory.batch_shape + memory.key_shape, dtype=bool)
+        return CDict(memory=memory, exist=exist, names=names)
 
-    def _index(self, key) -> int:
+    def _key(self, key):
+        if self.names is None:
+            return key
         try:
-            return self.keys.index(key)
+            return self.names.index(key)
         except ValueError:
-            raise KeyError(f"Unknown key: {key!r} (known: {self.keys})") from None
+            raise KeyError(f"Unknown key: {key!r} (known: {self.names})") from None
+
+    # ------------------------------------------------------------ properties
+    @property
+    def batch_shape(self) -> tuple:
+        return self.memory.batch_shape
+
+    @property
+    def is_batched(self) -> bool:
+        return self.memory.is_batched
+
+    @property
+    def value_shape(self) -> tuple:
+        return self.memory.value_shape
+
+    @property
+    def dtype(self):
+        return self.memory.dtype
+
+    @property
+    def data(self) -> jnp.ndarray:
+        return self.memory.data
+
+    # ------------------------------------------------------------ read/write
+    def contains(self, key) -> jnp.ndarray:
+        """Existence flag(s) for the given key(s)
+        (reference ``structures.py:1313``)."""
+        key = self._key(key)
+        parts, valid = self.memory._normalize_keys(key)
+        return self.exist[self.memory._address(parts)] & valid
 
     def get(self, key, default=None) -> jnp.ndarray:
-        return self.memory.get(self._index(key), default)
+        """Value(s) at ``key``; where a ``default`` is given, missing or
+        invalid keys yield the default instead of the stored filler."""
+        key = self._key(key)
+        if default is None:
+            return self.memory.get(key)
+        parts, valid = self.memory._normalize_keys(key)
+        idx = self.memory._address(parts)
+        present = valid & self.exist[idx]
+        value = self.memory.data[idx]
+        return do_where(
+            present,
+            value,
+            jnp.broadcast_to(jnp.asarray(default, self.dtype), value.shape),
+        )
 
     def __getitem__(self, key) -> jnp.ndarray:
         return self.get(key)
 
     def set_(self, key, value, where=None) -> "CDict":
-        return replace(self, memory=self.memory.set_(self._index(key), value, where))
+        """Masked overwrite; flags the key as existing."""
+        key = self._key(key)
+        parts, valid = self.memory._normalize_keys(key)
+        mask = valid
+        if where is not None:
+            mask = mask & jnp.broadcast_to(jnp.asarray(where), self.batch_shape)
+        idx = self.memory._address(parts)
+        new_exist = self.exist.at[idx].set(self.exist[idx] | mask)
+        return CDict(
+            memory=self.memory.set_(key, value, where),
+            exist=new_exist,
+            names=self.names,
+        )
+
+    def _arith(self, method, key, value, where) -> "CDict":
+        key = self._key(key)
+        return replace(self, memory=getattr(self.memory, method)(key, value, where))
 
     def add_(self, key, value, where=None) -> "CDict":
-        return replace(self, memory=self.memory.add_(self._index(key), value, where))
+        """Adds onto stored values; existence flags are NOT changed
+        (reference ``structures.py:1241``)."""
+        return self._arith("add_", key, value, where)
+
+    def subtract_(self, key, value, where=None) -> "CDict":
+        return self._arith("subtract_", key, value, where)
+
+    def multiply_(self, key, value, where=None) -> "CDict":
+        return self._arith("multiply_", key, value, where)
+
+    def divide_(self, key, value, where=None) -> "CDict":
+        return self._arith("divide_", key, value, where)
+
+    def clear(self, where=None) -> "CDict":
+        """Flag all keys non-existent — values are kept, as in the reference
+        (``structures.py:1349``); masked per batch element via ``where``."""
+        if where is None:
+            return replace(self, exist=jnp.zeros_like(self.exist))
+        where = jnp.broadcast_to(jnp.asarray(where), self.batch_shape)
+        m = where.reshape(where.shape + (1,) * self.memory.key_ndim)
+        return replace(self, exist=jnp.where(m, False, self.exist))
 
 
 @pytree_dataclass
 class CList:
-    """Fixed-capacity circular-buffer list with masked push/pop
-    (reference ``structures.py:1380``)."""
+    """Fixed-capacity circular-buffer list (deque) with masked push/pop
+    (reference ``structures.py:1380``); supports explicit batch shapes —
+    every batch element carries its own begin/length cursor."""
 
-    data: jnp.ndarray  # (capacity, *value_shape)
-    begin: jnp.ndarray  # scalar int32
-    length: jnp.ndarray  # scalar int32
+    data: jnp.ndarray  # (*batch_shape, capacity, *value_shape)
+    begin: jnp.ndarray  # (*batch_shape) int32
+    length: jnp.ndarray  # (*batch_shape) int32
+    batch_ndim: int = static_field(default=0)
 
     @staticmethod
-    def create(capacity: int, *value_shape: int, dtype=jnp.float32) -> "CList":
+    def create(
+        capacity: int,
+        *value_shape: int,
+        dtype=jnp.float32,
+        batch_shape: tuple = (),
+    ) -> "CList":
+        batch_shape = tuple(int(b) for b in batch_shape)
         return CList(
-            data=jnp.zeros((int(capacity),) + tuple(int(s) for s in value_shape), dtype=dtype),
-            begin=jnp.zeros((), jnp.int32),
-            length=jnp.zeros((), jnp.int32),
+            data=jnp.zeros(
+                batch_shape + (int(capacity),) + tuple(int(s) for s in value_shape),
+                dtype=dtype,
+            ),
+            begin=jnp.zeros(batch_shape, jnp.int32),
+            length=jnp.zeros(batch_shape, jnp.int32),
+            batch_ndim=len(batch_shape),
         )
+
+    # ------------------------------------------------------------ properties
+    @property
+    def batch_shape(self) -> tuple:
+        return self.data.shape[: self.batch_ndim]
+
+    @property
+    def is_batched(self) -> bool:
+        return self.batch_ndim > 0
 
     @property
     def capacity(self) -> int:
-        return self.data.shape[0]
+        return self.data.shape[self.batch_ndim]
+
+    # the reference's name for the same number
+    @property
+    def max_length(self) -> int:
+        return self.capacity
+
+    @property
+    def value_shape(self) -> tuple:
+        return self.data.shape[self.batch_ndim + 1 :]
+
+    @property
+    def dtype(self):
+        return self.data.dtype
 
     def __len__(self):
-        raise TypeError("Use .length (a traced scalar) instead of len() on a CList")
+        raise TypeError("Use .length (a traced array) instead of len() on a CList")
 
     @property
     def is_empty(self) -> jnp.ndarray:
@@ -161,55 +454,104 @@ class CList:
     def is_full(self) -> jnp.ndarray:
         return self.length == self.capacity
 
+    # ------------------------------------------------------------ addressing
     def _phys(self, i) -> jnp.ndarray:
         return (self.begin + jnp.asarray(i)) % self.capacity
 
-    def get(self, i, default=None) -> jnp.ndarray:
+    def _index(self, phys) -> tuple:
+        return _open_grid(self.batch_shape) + (phys,)
+
+    def _logical(self, i) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Logical index (negative = from the end, per element) -> physical
+        position + validity. Extra leading dims on ``i`` gather multiple
+        elements per list (array indexing on an unbatched list)."""
         i = jnp.asarray(i)
+        common = jnp.broadcast_shapes(self.batch_shape, i.shape)
+        i = jnp.broadcast_to(i, common)
         i = jnp.where(i < 0, i + self.length, i)
-        value = self.data[self._phys(i)]
+        valid = (i >= 0) & (i < self.length)
+        return self._phys(jnp.clip(i, 0, self.capacity - 1)), valid
+
+    # ------------------------------------------------------------ read/write
+    def get(self, i, default=None) -> jnp.ndarray:
+        phys, valid = self._logical(i)
+        value = self.data[self._index(phys)]
         if default is not None:
-            valid = (i >= 0) & (i < self.length)
-            value = do_where(valid, value, jnp.broadcast_to(jnp.asarray(default, self.data.dtype), value.shape))
+            value = do_where(
+                valid,
+                value,
+                jnp.broadcast_to(jnp.asarray(default, self.data.dtype), value.shape),
+            )
         return value
 
     def __getitem__(self, i) -> jnp.ndarray:
         return self.get(i)
 
-    def set_(self, i, value, where=None) -> "CList":
-        i = jnp.asarray(i)
-        i = jnp.where(i < 0, i + self.length, i)
-        valid = (i >= 0) & (i < self.length)
+    def _apply(self, i, op, value, where) -> "CList":
+        phys, valid = self._logical(i)
+        idx = self._index(phys)
+        current = self.data[idx]
+        value = jnp.broadcast_to(jnp.asarray(value, self.data.dtype), current.shape)
+        mask = valid
         if where is not None:
-            valid = valid & jnp.asarray(where)
-        current = self.data[self._phys(i)]
-        masked = do_where(valid, jnp.asarray(value, self.data.dtype), current)
-        return replace(self, data=self.data.at[self._phys(i)].set(masked))
+            mask = mask & jnp.broadcast_to(jnp.asarray(where), valid.shape)
+        new = do_where(mask, op(current, value), current)
+        return replace(self, data=self.data.at[idx].set(new))
+
+    def set_(self, i, value, where=None) -> "CList":
+        return self._apply(i, lambda cur, v: v, value, where)
+
+    def add_(self, i, value, where=None) -> "CList":
+        return self._apply(i, lambda cur, v: cur + v, value, where)
+
+    def subtract_(self, i, value, where=None) -> "CList":
+        return self._apply(i, lambda cur, v: cur - v, value, where)
+
+    def multiply_(self, i, value, where=None) -> "CList":
+        return self._apply(i, lambda cur, v: cur * v, value, where)
+
+    def divide_(self, i, value, where=None) -> "CList":
+        return self._apply(i, lambda cur, v: cur / v, value, where)
+
+    # ------------------------------------------------------------ push/pop
+    def _can(self, other, where):
+        can = other
+        if where is not None:
+            can = can & jnp.broadcast_to(jnp.asarray(where), self.batch_shape)
+        return can
 
     def append_(self, value, where=None) -> "CList":
-        """Push to the end unless full (masked; reference ``push_``)."""
-        can = ~self.is_full
-        if where is not None:
-            can = can & jnp.asarray(where)
-        pos = self._phys(self.length)
-        current = self.data[pos]
-        new_val = do_where(can, jnp.broadcast_to(jnp.asarray(value, self.data.dtype), current.shape), current)
+        """Push to the end unless full (masked; reference ``append_``)."""
+        can = self._can(~self.is_full, where)
+        idx = self._index(self._phys(self.length % self.capacity))
+        current = self.data[idx]
+        new = do_where(
+            can,
+            jnp.broadcast_to(jnp.asarray(value, self.data.dtype), current.shape),
+            current,
+        )
         return replace(
             self,
-            data=self.data.at[pos].set(new_val),
+            data=self.data.at[idx].set(new),
             length=self.length + can.astype(jnp.int32),
         )
 
+    # the reference's alias
+    push_ = append_
+
     def appendleft_(self, value, where=None) -> "CList":
-        can = ~self.is_full
-        if where is not None:
-            can = can & jnp.asarray(where)
+        can = self._can(~self.is_full, where)
         new_begin = jnp.where(can, (self.begin - 1) % self.capacity, self.begin)
-        current = self.data[new_begin]
-        new_val = do_where(can, jnp.broadcast_to(jnp.asarray(value, self.data.dtype), current.shape), current)
+        idx = self._index(new_begin)
+        current = self.data[idx]
+        new = do_where(
+            can,
+            jnp.broadcast_to(jnp.asarray(value, self.data.dtype), current.shape),
+            current,
+        )
         return replace(
             self,
-            data=self.data.at[new_begin].set(new_val),
+            data=self.data.at[idx].set(new),
             begin=new_begin,
             length=self.length + can.astype(jnp.int32),
         )
@@ -217,73 +559,154 @@ class CList:
     def pop_(self, where=None) -> tuple:
         """Pop from the end (masked); returns ``(new_list, value)`` where the
         value is the popped item (stale data when the pop was masked out)."""
-        can = ~self.is_empty
-        if where is not None:
-            can = can & jnp.asarray(where)
-        pos = self._phys(jnp.maximum(self.length - 1, 0))
-        value = self.data[pos]
+        can = self._can(~self.is_empty, where)
+        phys = self._phys(jnp.maximum(self.length - 1, 0))
+        value = self.data[self._index(phys)]
         return replace(self, length=self.length - can.astype(jnp.int32)), value
 
     def popleft_(self, where=None) -> tuple:
-        can = ~self.is_empty
-        if where is not None:
-            can = can & jnp.asarray(where)
-        value = self.data[self.begin]
+        can = self._can(~self.is_empty, where)
+        value = self.data[self._index(self.begin)]
         new_begin = jnp.where(can, (self.begin + 1) % self.capacity, self.begin)
         return (
             replace(self, begin=new_begin, length=self.length - can.astype(jnp.int32)),
             value,
         )
 
+    def clear(self, where=None) -> "CList":
+        """Empty the list(s); masked per batch element via ``where``
+        (reference ``structures.py:1976``)."""
+        if where is None:
+            return replace(self, length=jnp.zeros_like(self.length))
+        where = jnp.broadcast_to(jnp.asarray(where), self.batch_shape)
+        return replace(self, length=jnp.where(where, 0, self.length))
+
 
 @pytree_dataclass
 class CBag:
-    """A bag (multiset) of integers in ``[0, num_keys)`` with random pop
-    (reference ``structures.py:2024``)."""
+    """A bag (multiset) of integers in ``[0, num_keys)`` with random pop —
+    sampling without replacement (reference ``structures.py:2024``).
 
-    counts: jnp.ndarray  # (num_keys,) int32
+    Implementation deviation (documented in the module docstring): the bag
+    keeps per-key counts instead of shuffled slots; ``pop_`` draws a present
+    key uniformly and decrements it, which has exactly the without-replacement
+    sampling distribution of the reference's shuffle+popleft. ``capacity``
+    optionally bounds the total number of contained elements (the reference's
+    ``max_length``); pushes into a full bag are masked no-ops.
+    """
+
+    counts: jnp.ndarray  # (*batch_shape, num_keys) int32
+    batch_ndim: int = static_field(default=0)
+    capacity: Optional[int] = static_field(default=None)
 
     @staticmethod
-    def create(num_keys: int) -> "CBag":
-        return CBag(counts=jnp.zeros(int(num_keys), dtype=jnp.int32))
+    def create(
+        num_keys: int, *, batch_shape: tuple = (), capacity: Optional[int] = None
+    ) -> "CBag":
+        batch_shape = tuple(int(b) for b in batch_shape)
+        return CBag(
+            counts=jnp.zeros(batch_shape + (int(num_keys),), dtype=jnp.int32),
+            batch_ndim=len(batch_shape),
+            capacity=None if capacity is None else int(capacity),
+        )
+
+    # ------------------------------------------------------------ properties
+    @property
+    def batch_shape(self) -> tuple:
+        return self.counts.shape[: self.batch_ndim]
+
+    @property
+    def is_batched(self) -> bool:
+        return self.batch_ndim > 0
 
     @property
     def num_keys(self) -> int:
-        return self.counts.shape[0]
+        return self.counts.shape[-1]
 
     @property
     def total(self) -> jnp.ndarray:
-        return jnp.sum(self.counts)
+        return jnp.sum(self.counts, axis=-1)
 
+    # the reference's name for the same number
+    @property
+    def length(self) -> jnp.ndarray:
+        return self.total
+
+    @property
+    def max_length(self) -> Optional[int]:
+        return self.capacity
+
+    # ------------------------------------------------------------ operations
     def push_(self, key, where=None) -> "CBag":
-        key = jnp.asarray(key)
-        inc = jnp.ones((), jnp.int32) if where is None else jnp.asarray(where).astype(jnp.int32)
-        return replace(self, counts=self.counts.at[key].add(inc))
+        key = jnp.broadcast_to(jnp.asarray(key), self.batch_shape)
+        ok = (key >= 0) & (key < self.num_keys)
+        if self.capacity is not None:
+            ok = ok & (self.total < self.capacity)
+        if where is not None:
+            ok = ok & jnp.broadcast_to(jnp.asarray(where), self.batch_shape)
+        idx = _open_grid(self.batch_shape) + (jnp.clip(key, 0, self.num_keys - 1),)
+        return replace(self, counts=self.counts.at[idx].add(ok.astype(jnp.int32)))
+
+    def clear(self, where=None) -> "CBag":
+        if where is None:
+            return replace(self, counts=jnp.zeros_like(self.counts))
+        where = jnp.broadcast_to(jnp.asarray(where), self.batch_shape)
+        return replace(self, counts=jnp.where(where[..., None], 0, self.counts))
+
+    def _pop_specific(self, key, where) -> tuple:
+        key = jnp.broadcast_to(jnp.asarray(key), self.batch_shape)
+        idx = _open_grid(self.batch_shape) + (jnp.clip(key, 0, self.num_keys - 1),)
+        ok = (key >= 0) & (key < self.num_keys) & (self.counts[idx] > 0)
+        if where is not None:
+            ok = ok & jnp.broadcast_to(jnp.asarray(where), self.batch_shape)
+        new = replace(self, counts=self.counts.at[idx].add(-ok.astype(jnp.int32)))
+        return new, key, ok
+
+    def _pop_random(self, rng, where) -> tuple:
+        def draw(key, counts):
+            probs = counts.astype(jnp.float32)
+            total = jnp.sum(probs)
+            safe = jnp.where(
+                total > 0,
+                probs / jnp.maximum(total, 1.0),
+                jnp.ones_like(probs) / probs.shape[0],
+            )
+            return jax.random.choice(key, probs.shape[0], p=safe)
+
+        bs = self.batch_shape
+        if bs:
+            n = 1
+            for d in bs:
+                n *= d
+            keys = jax.random.split(rng, n).reshape(bs)
+            picked = jax.vmap(draw)(
+                keys.reshape(n), self.counts.reshape(n, self.num_keys)
+            ).reshape(bs)
+        else:
+            picked = draw(rng, self.counts)
+        idx = _open_grid(bs) + (picked,)
+        ok = self.counts[idx] > 0
+        if where is not None:
+            ok = ok & jnp.broadcast_to(jnp.asarray(where), bs)
+        new = replace(self, counts=self.counts.at[idx].add(-ok.astype(jnp.int32)))
+        return new, picked, ok
 
     def pop_(self, key_or_rng, where=None) -> tuple:
-        """Pop a specific key (int) or a uniformly random present key (PRNG
-        key, typed or legacy uint32). Returns ``(new_bag, popped_key, ok)``."""
+        """Pop a specific key (integer(s)) or a uniformly random present key
+        (PRNG key, typed or legacy uint32). Returns
+        ``(new_bag, popped_key, ok)`` with everything batch-shaped."""
         is_legacy_prng = (
             hasattr(key_or_rng, "dtype")
             and jnp.asarray(key_or_rng).dtype == jnp.uint32
             and jnp.asarray(key_or_rng).shape == (2,)
         )
         if is_legacy_prng:
-            key_or_rng = jax.random.wrap_key_data(jnp.asarray(key_or_rng))
-        if isinstance(key_or_rng, (int,)) or (
+            return self._pop_random(
+                jax.random.wrap_key_data(jnp.asarray(key_or_rng)), where
+            )
+        if isinstance(key_or_rng, int) or (
             hasattr(key_or_rng, "dtype")
             and jnp.issubdtype(jnp.asarray(key_or_rng).dtype, jnp.integer)
-            and jnp.asarray(key_or_rng).ndim == 0
         ):
-            key = jnp.asarray(key_or_rng)
-            ok = self.counts[key] > 0
-        else:
-            probs = self.counts.astype(jnp.float32)
-            total = jnp.sum(probs)
-            safe = jnp.where(total > 0, probs / jnp.maximum(total, 1), jnp.ones_like(probs) / self.num_keys)
-            key = jax.random.choice(key_or_rng, self.num_keys, p=safe)
-            ok = total > 0
-        if where is not None:
-            ok = ok & jnp.asarray(where)
-        dec = ok.astype(jnp.int32)
-        return replace(self, counts=self.counts.at[key].add(-dec)), key, ok
+            return self._pop_specific(key_or_rng, where)
+        return self._pop_random(key_or_rng, where)

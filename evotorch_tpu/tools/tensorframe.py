@@ -1,15 +1,24 @@
 """``TensorFrame``: a pandas-like columnar table of device arrays.
 
 Parity: reference ``tools/tensorframe.py:53-1338`` (columnar table of
-tensors, vmap-compatible, with the ``Picker`` row indexer). Implemented as a
-pytree dataclass of named equal-length columns, so whole frames pass through
-``jit``/``vmap``/``scan``; mutating operations return new frames.
+tensors, vmap-compatible, with the ``Picker`` row indexer supporting both
+``frame.pick[rows]`` and ``frame.pick[rows, columns]`` addressing, row
+assignment, ``hstack``/``vstack``/``join``, ``argsort``/``sort``/
+``nlargest``/``nsmallest``, and the vmapped per-row ``each``). Implemented as
+a pytree dataclass of named equal-length columns, so whole frames pass
+through ``jit``/``vmap``/``scan``.
+
+TPU-first deviation: frames are immutable pytrees, so the reference's
+in-place ``frame.pick[rows] = values`` becomes the functional
+``frame.pick_set(rows, values)`` (returning a new frame); boolean-mask
+assignment lowers to ``jnp.where`` so it stays jit/vmap-traceable.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Mapping, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -103,10 +112,26 @@ class TensorFrame:
             data=tuple(a[indices] for a in self.data),
         )
 
-    def sort_values(self, by: str, *, descending: bool = False) -> "TensorFrame":
+    def argsort(self, by: str, *, descending: bool = False) -> jnp.ndarray:
+        """Indices that would sort the frame by column ``by``
+        (reference ``tensorframe.py:807``)."""
         key = self[by]
-        order = jnp.argsort(-key if descending else key)
-        return self.take(order)
+        return jnp.argsort(-key if descending else key)
+
+    def sort_values(self, by: str, *, descending: bool = False) -> "TensorFrame":
+        return self.take(self.argsort(by, descending=descending))
+
+    # the reference's shorter name
+    def sort(self, by: str, *, descending: bool = False) -> "TensorFrame":
+        return self.sort_values(by, descending=descending)
+
+    def nlargest(self, n: int, by: str) -> "TensorFrame":
+        """The ``n`` rows with the largest values under column ``by``
+        (reference ``tensorframe.py:1060``)."""
+        return self.take(self.argsort(by, descending=True)[: int(n)])
+
+    def nsmallest(self, n: int, by: str) -> "TensorFrame":
+        return self.take(self.argsort(by)[: int(n)])
 
     def concat(self, other: "TensorFrame") -> "TensorFrame":
         if self.columns != other.columns:
@@ -115,6 +140,111 @@ class TensorFrame:
             columns=self.columns,
             data=tuple(jnp.concatenate([a, b]) for a, b in zip(self.data, other.data)),
         )
+
+    # the reference's name for row-wise concatenation
+    def vstack(self, other: "TensorFrame") -> "TensorFrame":
+        return self.concat(other)
+
+    def hstack(self, other: "TensorFrame", *, override: bool = False) -> "TensorFrame":
+        """Column-wise join (reference ``tensorframe.py:881``). Overlapping
+        column names raise unless ``override=True``, in which case ``other``'s
+        values win."""
+        overlap = set(self.columns) & set(other.columns)
+        if overlap and not override:
+            raise ValueError(
+                f"Overlapping columns {sorted(overlap)}; pass override=True to"
+                " let the right-hand frame's values take precedence"
+            )
+        return self.with_columns(**other.as_dict())
+
+    def join(self, other: "TensorFrame") -> "TensorFrame":
+        """pandas-style alias of :meth:`hstack`
+        (reference ``tensorframe.py:1092``)."""
+        return self.hstack(other)
+
+    def drop(self, *, columns) -> "TensorFrame":
+        """Frame without the given column(s)
+        (reference ``tensorframe.py:1107``)."""
+        if isinstance(columns, str):
+            columns = [columns]
+        missing = set(columns) - set(self.columns)
+        if missing:
+            raise ValueError(f"Cannot drop unknown columns: {sorted(missing)}")
+        return self.without_columns(*columns)
+
+    # ------------------------------------------------------------- row write
+    def pick_set(self, rows, new_values, columns=None) -> "TensorFrame":
+        """Functional row assignment — the immutable form of the reference's
+        ``frame.pick[rows] = values`` (``tensorframe.py:1306-1338``).
+
+        ``rows`` may be a slice, an integer index array, or a boolean mask
+        (the mask form lowers to ``jnp.where``, so it is jit/vmap-safe).
+        ``new_values`` may be an array (single target column), a mapping of
+        column name -> values, or another ``TensorFrame``.
+        """
+        if isinstance(new_values, TensorFrame):
+            updates = new_values.as_dict()
+        elif isinstance(new_values, Mapping):
+            updates = dict(new_values)
+        else:
+            if columns is None:
+                raise ValueError(
+                    "When new_values is a plain array, pass the target column"
+                    " via `columns=`"
+                )
+            if isinstance(columns, str):
+                columns = [columns]
+            if len(columns) != 1:
+                raise ValueError(
+                    "A plain-array right-hand side updates exactly one column"
+                )
+            updates = {columns[0]: new_values}
+        if columns is not None:
+            target = [columns] if isinstance(columns, str) else list(columns)
+            if set(target) != set(updates):
+                raise ValueError(
+                    f"Target columns {sorted(target)} do not match the"
+                    f" right-hand side columns {sorted(updates)}"
+                )
+        unknown = set(updates) - set(self.columns)
+        if unknown:
+            raise KeyError(f"No such column(s): {sorted(unknown)}")
+
+        def write(current, new):
+            new = jnp.asarray(new, current.dtype)
+            if isinstance(rows, slice):
+                return current.at[rows].set(new)
+            sel = jnp.asarray(rows)
+            if sel.dtype == jnp.bool_:
+                m = sel.reshape(sel.shape + (1,) * (current.ndim - 1))
+                return jnp.where(m, jnp.broadcast_to(new, current.shape), current)
+            return current.at[sel].set(new)
+
+        out = {}
+        for name, col in self.as_dict().items():
+            out[name] = write(col, updates[name]) if name in updates else col
+        return TensorFrame(columns=self.columns, data=tuple(out.values()))
+
+    # ----------------------------------------------------------- row compute
+    def each(
+        self,
+        fn: Callable[[dict], dict],
+        *,
+        join: bool = False,
+        override: bool = False,
+    ) -> "TensorFrame":
+        """Apply ``fn`` (dict-of-scalars -> dict-of-scalars) to every row,
+        vectorized with ``jax.vmap`` (reference ``tensorframe.py:953`` uses
+        ``torch.vmap`` the same way). With ``join=True`` the input columns are
+        kept alongside the outputs (``override=True`` lets new columns shadow
+        same-named inputs)."""
+        if (not join) and override:
+            raise ValueError("override=True requires join=True")
+        out = jax.vmap(fn)(self.as_dict())
+        result = TensorFrame.create(out)
+        if join:
+            return self.hstack(result, override=override)
+        return result
 
     # ---------------------------------------------------------------- output
     def to_pandas(self):
@@ -128,18 +258,47 @@ class TensorFrame:
 
 
 class Picker:
-    """Row indexer over a TensorFrame (reference ``tensorframe.py`` ``Picker``)."""
+    """Row indexer over a TensorFrame (reference ``tensorframe.py:1270``):
+    ``frame.pick[rows]`` or ``frame.pick[rows, columns]`` where ``columns``
+    is a name, a list of names, or ``:``. Assignment is functional —
+    use :meth:`TensorFrame.pick_set` (immutability deviation, see module
+    docstring); ``pick[...] = ...`` raises with that pointer."""
 
     def __init__(self, frame: TensorFrame):
         self._frame = frame
 
-    def __getitem__(self, selector) -> TensorFrame:
+    @staticmethod
+    def _unpack(frame: TensorFrame, location):
+        if isinstance(location, tuple):
+            rows, columns = location
+            if isinstance(columns, str):
+                columns = [columns]
+            elif isinstance(columns, slice):
+                if columns != slice(None):
+                    raise ValueError("For columns, only ':' is supported")
+                columns = list(frame.columns)
+            else:
+                columns = [str(c) for c in columns]
+        else:
+            rows, columns = location, list(frame.columns)
+        return rows, columns
+
+    def __getitem__(self, location) -> TensorFrame:
         frame = self._frame
-        if isinstance(selector, slice):
-            return TensorFrame(
-                columns=frame.columns, data=tuple(a[selector] for a in frame.data)
-            )
-        selector = jnp.asarray(selector)
-        if selector.dtype == jnp.bool_:
-            selector = jnp.nonzero(selector)[0]
-        return frame.take(selector)
+        rows, columns = self._unpack(frame, location)
+        sub = {name: frame[name] for name in columns}
+        if isinstance(rows, slice):
+            data = {k: v[rows] for k, v in sub.items()}
+        else:
+            sel = jnp.asarray(rows)
+            if sel.dtype == jnp.bool_:
+                sel = jnp.nonzero(sel)[0]
+            data = {k: v[sel] for k, v in sub.items()}
+        return TensorFrame(columns=tuple(data.keys()), data=tuple(data.values()))
+
+    def __setitem__(self, location, new_values):
+        raise TypeError(
+            "TensorFrames are immutable pytrees; use"
+            " frame.pick_set(rows, values, columns=...) which returns the"
+            " updated frame"
+        )

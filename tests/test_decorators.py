@@ -76,3 +76,95 @@ def test_rowwise():
     batched = norm(jnp.ones((5, 4, 9)))
     assert batched.shape == (5, 4)
     assert norm.__evotorch_vectorized__
+
+
+# -- expects_ndim kwargs participation + coercion (reference 613-874) --------
+
+
+def test_expects_ndim_kwargs_participate():
+    from evotorch_tpu.decorators import expects_ndim
+
+    @expects_ndim(1, 0)
+    def scaled_norm(x, scale):
+        return scale * jnp.sum(x * x)
+
+    x = jnp.ones((4, 3))  # batch of 4 rows
+    # scale passed by keyword must still batch against its declared ndim
+    out = scaled_norm(x, scale=jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+    assert out.shape == (4,)
+    np.testing.assert_allclose(np.asarray(out), [3.0, 6.0, 9.0, 12.0])
+    # both by keyword, out of order
+    out2 = scaled_norm(scale=jnp.asarray(2.0), x=x)
+    np.testing.assert_allclose(np.asarray(out2), 6.0)
+
+
+def test_expects_ndim_kwargs_with_defaults_and_static():
+    from evotorch_tpu.decorators import expects_ndim
+
+    @expects_ndim(1, 0)
+    def f(x, scale=2.0, *, mode="sum"):
+        agg = jnp.sum if mode == "sum" else jnp.max
+        return scale * agg(x)
+
+    x = jnp.ones((3, 2))
+    np.testing.assert_allclose(np.asarray(f(x)), [4.0, 4.0, 4.0])
+    np.testing.assert_allclose(np.asarray(f(x, mode="max")), [2.0, 2.0, 2.0])
+
+
+def test_expects_ndim_scalar_coercion_follows_float_dtype():
+    from evotorch_tpu.decorators import expects_ndim
+
+    seen = {}
+
+    @expects_ndim(1, 0)
+    def f(x, s):
+        seen["s_dtype"] = s.dtype
+        return x * s
+
+    x16 = jnp.ones(3, dtype=jnp.bfloat16)
+    out = f(x16, 0.5)  # python float adopts the array's dtype
+    assert seen["s_dtype"] == jnp.bfloat16
+    assert out.dtype == jnp.bfloat16
+
+    # numpy float64 input likewise follows the jax argument's dtype
+    f(jnp.ones(3, dtype=jnp.float32), np.float64(0.25))
+    assert seen["s_dtype"] == jnp.float32
+
+    # integer scalars are not forced to float
+    @expects_ndim(1, 0)
+    def g(x, n):
+        seen["n_dtype"] = n.dtype
+        return x * n
+
+    g(jnp.ones(3), 4)
+    assert jnp.issubdtype(seen["n_dtype"], jnp.integer)
+
+
+def test_expects_ndim_kwargs_batched_search():
+    # the batched-searches pattern with keyword call style: a (B, L) center
+    # batch against a per-search stdev batch
+    from evotorch_tpu.decorators import expects_ndim
+
+    @expects_ndim(1, 1)
+    def quad(center, stdev):
+        return jnp.sum(center**2) + jnp.sum(stdev)
+
+    out = quad(
+        center=jnp.ones((2, 5)),
+        stdev=jnp.stack([jnp.full(5, 0.1), jnp.full(5, 0.2)]),
+    )
+    assert out.shape == (2,)
+    np.testing.assert_allclose(np.asarray(out), [5.5, 6.0], atol=1e-6)
+
+
+def test_expects_ndim_varargs_function_with_kwargs():
+    # review regression: a *args-bearing function called with a keyword must
+    # not trip the VAR_POSITIONAL guard (apply_defaults inserts an empty tuple)
+    from evotorch_tpu.decorators import expects_ndim
+
+    @expects_ndim(1, 0)
+    def f(x, s, *extra):
+        return s * jnp.sum(x)
+
+    out = f(jnp.ones((2, 3)), s=jnp.asarray(2.0))
+    np.testing.assert_allclose(np.asarray(out), [6.0, 6.0])

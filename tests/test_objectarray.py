@@ -80,3 +80,73 @@ def test_eq_with_array_elements():
     result = a == [np.array([1, 3]), 5]
     assert result.tolist() == [False, True]
     assert (a == [1]).tolist() == [False, False]
+
+
+# -- tensor-like introspection breadth (reference objectarray.py:204-534) ----
+
+
+def test_shape_size_numel():
+    from evotorch_tpu.tools import ObjectArray
+
+    arr = ObjectArray.from_values(["a", [1, 2], 3])
+    assert arr.shape == (3,)
+    assert arr.size() == (3,)
+    assert arr.size(0) == 3
+    assert arr.ndim == 1 and arr.dim() == 1
+    assert arr.numel() == 3
+    assert arr.device == "cpu"
+
+
+def test_repeat():
+    from evotorch_tpu.tools import ObjectArray
+
+    arr = ObjectArray.from_values([1, "x"])
+    rep = arr.repeat(3)
+    assert list(rep) == [1, "x", 1, "x", 1, "x"]
+    import pytest
+
+    with pytest.raises(ValueError):
+        arr.repeat(2, 2)
+
+
+def test_from_numpy_and_storage_ptr():
+    import numpy as np
+
+    from evotorch_tpu.tools import ObjectArray
+
+    src = np.empty(3, dtype=object)
+    src[0], src[1], src[2] = "a", "b", "c"
+    arr = ObjectArray.from_numpy(src)
+    assert list(arr) == ["a", "b", "c"]
+    # views share storage; clones do not
+    view = arr[1:]
+    assert view.storage_ptr() == arr.storage_ptr()
+    assert arr.clone().storage_ptr() != arr.storage_ptr()
+
+
+def test_clone_preserve_read_only_and_copy():
+    import copy
+
+    from evotorch_tpu.tools import ObjectArray
+
+    arr = ObjectArray.from_values([[1, 2], "y"]).get_read_only_view()
+    plain = arr.clone()
+    assert not plain.is_read_only
+    kept = arr.clone(preserve_read_only=True)
+    assert kept.is_read_only
+    via_copy = copy.copy(arr)
+    assert via_copy.is_read_only
+    deep = copy.deepcopy(arr)
+    assert list(deep[0]) == [1, 2]
+
+
+def test_set_item_and_pickle():
+    import pickle
+
+    from evotorch_tpu.tools import ObjectArray
+
+    arr = ObjectArray(2)
+    arr.set_item(0, [3, 4])
+    arr.set_item(1, "z")
+    back = pickle.loads(pickle.dumps(arr))
+    assert list(back[0]) == [3, 4] and back[1] == "z"

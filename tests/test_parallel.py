@@ -136,3 +136,66 @@ def test_sharded_evaluator_multi_output():
     # unaligned popsize too
     fit13, extra13 = ev(values[:13])
     assert fit13.shape == (13,) and extra13.shape == (13, 2)
+
+
+def test_sharded_training_identical_across_topologies():
+    """3 PGPE generations on the flagship Humanoid with the population
+    sharded over pop x model meshes 8x1 / 4x2 / 2x4: the mesh topology is an
+    execution detail under GSPMD, so the trained center must be identical
+    (VERDICT r1 item 10)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from evotorch_tpu.algorithms.functional import pgpe, pgpe_ask, pgpe_tell
+    from evotorch_tpu.envs import Humanoid
+    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+    from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+    from evotorch_tpu.neuroevolution.net.vecrl import run_vectorized_rollout
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    env = Humanoid()
+    net = Linear(env.observation_size, 8) >> Tanh() >> Linear(8, env.action_size)
+    policy = FlatParamsPolicy(net)
+    stats = RunningNorm(env.observation_size).stats
+    popsize, episode_length, generations = 8, 3, 3
+
+    def train(pop_axis, model_axis):
+        mesh = Mesh(
+            np.asarray(jax.devices()[:8]).reshape(pop_axis, model_axis),
+            axis_names=("pop", "model"),
+        )
+        sharding = NamedSharding(mesh, P("pop", "model"))
+        state = pgpe(
+            center_init=jnp.zeros(policy.parameter_count, dtype=jnp.float32),
+            center_learning_rate=0.1,
+            stdev_learning_rate=0.1,
+            objective_sense="max",
+            stdev_init=0.1,
+        )
+
+        @jax.jit
+        def step(state, key):
+            k1, k2 = jax.random.split(key)
+            values = pgpe_ask(k1, state, popsize=popsize)
+            values = jax.lax.with_sharding_constraint(values, sharding)
+            result = run_vectorized_rollout(
+                env, policy, values, k2, stats,
+                num_episodes=1, episode_length=episode_length,
+                eval_mode="budget",
+            )
+            return pgpe_tell(state, values, result.scores), result.scores
+
+        key = jax.random.key(42)
+        for _ in range(generations):
+            key, sub = jax.random.split(key)
+            state, scores = step(state, sub)
+        return np.asarray(state.optimizer_state.center), np.asarray(scores)
+
+    center_81, scores_81 = train(8, 1)
+    center_42, scores_42 = train(4, 2)
+    center_24, scores_24 = train(2, 4)
+    np.testing.assert_allclose(center_42, center_81, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(center_24, center_81, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(scores_42, scores_81, atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(scores_24, scores_81, atol=1e-4, rtol=1e-4)

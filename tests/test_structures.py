@@ -101,3 +101,160 @@ def test_cbag_legacy_prng_key():
     assert np.asarray(k).shape == ()
     assert bool(ok) and int(k) in (0, 2)
     assert int(bag2.total) == 2
+
+
+# -- explicit batch shapes (reference structures.py batch semantics) ---------
+
+
+def test_cmemory_batched_per_element_keys():
+    m = CMemory.create(4, 2, batch_shape=(3,))
+    assert m.is_batched and m.batch_shape == (3,)
+    keys = jnp.asarray([0, 1, 3])
+    vals = jnp.stack([jnp.full(2, 10.0), jnp.full(2, 20.0), jnp.full(2, 30.0)])
+    m = m.set_(keys, vals)
+    got = m.get(keys)
+    assert got.shape == (3, 2)
+    np.testing.assert_allclose(np.asarray(got[:, 0]), [10.0, 20.0, 30.0])
+    # other slots untouched
+    np.testing.assert_allclose(np.asarray(m.get(jnp.asarray([1, 0, 0]))), 0.0)
+
+
+def test_cmemory_batched_where_mask():
+    m = CMemory.create(3, batch_shape=(4,))
+    m = m.set_(jnp.zeros(4, jnp.int32), jnp.asarray([1.0, 2.0, 3.0, 4.0]),
+               where=jnp.asarray([True, False, True, False]))
+    np.testing.assert_allclose(np.asarray(m.get(jnp.zeros(4, jnp.int32))),
+                               [1.0, 0.0, 3.0, 0.0])
+    m = m.add_(jnp.zeros(4, jnp.int32), 10.0, where=jnp.asarray([False, True, True, True]))
+    np.testing.assert_allclose(np.asarray(m.get(jnp.zeros(4, jnp.int32))),
+                               [1.0, 10.0, 13.0, 10.0])
+
+
+def test_cmemory_multidim_keys_and_offset():
+    # num_keys=(3, 5) with key_offset=1: keys range over (1..3, 1..5)
+    m = CMemory.create((3, 5), key_offset=1)
+    m = m.set_((jnp.asarray(2), jnp.asarray(4)), 7.0)
+    assert float(m.get((2, 4))) == 7.0
+    # key given as a trailing-dim array
+    assert float(m.get(jnp.asarray([2, 4]))) == 7.0
+    # out-of-range -> default
+    assert float(m.get((0, 1), default=-1.0)) == -1.0
+    assert float(m.get((1, 1), default=-1.0)) == 0.0
+
+
+def test_cmemory_add_circular():
+    m = CMemory.create(2, fill=5.0)
+    m = m.add_circular_(0, 4.0, 6.0)  # (5 + 4) % 6 = 3
+    assert float(m[0]) == 3.0
+    assert float(m[1]) == 5.0
+
+
+def test_cmemory_invalid_key_write_is_noop():
+    m = CMemory.create(3, batch_shape=(2,))
+    m = m.set_(jnp.asarray([1, 9]), jnp.asarray([5.0, 5.0]))  # 9 invalid
+    np.testing.assert_allclose(np.asarray(m.data[1]), 0.0)
+    np.testing.assert_allclose(np.asarray(m.data[0, 1]), 5.0)
+
+
+def test_cdict_integer_keys_existence():
+    d = CDict.create(5, 2)
+    assert not bool(d.contains(3))
+    # arithmetic on a missing key does not create it (reference semantics)
+    d = d.add_(3, 1.0)
+    assert not bool(d.contains(3))
+    assert float(d.get(3, default=-9.0)[0]) == -9.0
+    d = d.set_(3, jnp.asarray([4.0, 5.0]))
+    assert bool(d.contains(3))
+    np.testing.assert_allclose(np.asarray(d.get(3, default=-9.0)), [4.0, 5.0])
+    # arithmetic on an existing key updates it but existence is unchanged
+    d = d.add_(3, 1.0)
+    np.testing.assert_allclose(np.asarray(d.get(3, default=-9.0)), [5.0, 6.0])
+    # clear resets existence, not values
+    d = d.clear()
+    assert not bool(d.contains(3))
+    np.testing.assert_allclose(np.asarray(d.memory.get(3)), [5.0, 6.0])
+
+
+def test_cdict_batched_clear_where():
+    d = CDict.create(3, batch_shape=(2,))
+    d = d.set_(jnp.asarray([0, 1]), jnp.asarray([1.0, 2.0]))
+    assert np.asarray(d.contains(jnp.asarray([0, 1]))).all()
+    d = d.clear(where=jnp.asarray([True, False]))
+    got = np.asarray(d.contains(jnp.asarray([0, 1])))
+    assert not got[0] and got[1]
+
+
+def test_clist_batched_independent_cursors():
+    lst = CList.create(3, batch_shape=(2,))
+    lst = lst.append_(jnp.asarray([1.0, 10.0]))
+    lst = lst.append_(jnp.asarray([2.0, 20.0]), where=jnp.asarray([True, False]))
+    np.testing.assert_array_equal(np.asarray(lst.length), [2, 1])
+    np.testing.assert_allclose(np.asarray(lst.get(jnp.asarray([1, 0]))), [2.0, 10.0])
+    lst, v = lst.pop_(where=jnp.asarray([True, True]))
+    np.testing.assert_array_equal(np.asarray(lst.length), [1, 0])
+    np.testing.assert_allclose(np.asarray(v), [2.0, 10.0])
+    # arithmetic at a logical index
+    lst = lst.add_(jnp.asarray([0, 0]), 5.0)  # lane 1 is empty -> masked no-op
+    np.testing.assert_allclose(float(lst.get(jnp.asarray([0, 0]))[0]), 6.0)
+
+
+def test_clist_clear_and_get_default():
+    lst = CList.create(4).append_(1.0).append_(2.0)
+    assert float(lst.get(5, default=-1.0)) == -1.0
+    lst = lst.clear()
+    assert int(lst.length) == 0
+    assert float(lst.get(0, default=-1.0)) == -1.0
+
+
+def test_cbag_capacity_and_batch():
+    bag = CBag.create(3, capacity=2, batch_shape=(2,))
+    bag = bag.push_(jnp.asarray([0, 1]))
+    bag = bag.push_(jnp.asarray([0, 2]))
+    bag = bag.push_(jnp.asarray([1, 2]))  # both full -> masked no-op
+    np.testing.assert_array_equal(np.asarray(bag.total), [2, 2])
+    bag, keys, ok = bag.pop_(jax.random.key(0))
+    assert np.asarray(ok).all()
+    assert keys.shape == (2,)
+    np.testing.assert_array_equal(np.asarray(bag.total), [1, 1])
+    bag = bag.clear(where=jnp.asarray([True, False]))
+    np.testing.assert_array_equal(np.asarray(bag.total), [0, 1])
+
+
+def test_structures_batched_under_jit():
+    @jax.jit
+    def roundtrip(m, d, lst):
+        m = m.set_(jnp.asarray([1, 2]), jnp.asarray([1.0, 2.0]))
+        d = d.set_(jnp.asarray([0, 0]), jnp.asarray([3.0, 4.0]))
+        lst = lst.append_(jnp.asarray([5.0, 6.0]))
+        return m, d, lst
+
+    m, d, lst = roundtrip(
+        CMemory.create(4, batch_shape=(2,)),
+        CDict.create(4, batch_shape=(2,)),
+        CList.create(4, batch_shape=(2,)),
+    )
+    np.testing.assert_allclose(np.asarray(m.get(jnp.asarray([1, 2]))), [1.0, 2.0])
+    assert np.asarray(d.contains(jnp.asarray([0, 0]))).all()
+    np.testing.assert_array_equal(np.asarray(lst.length), [1, 1])
+
+
+def test_cmemory_unbatched_array_key_gather():
+    # review regression: an unbatched memory indexed with an ARRAY of keys
+    # gathers multiple slots (plain multi-element indexing)
+    m = CMemory.create(4, 2)
+    m = m.set_(1, jnp.asarray([5.0, 6.0])).set_(2, jnp.asarray([7.0, 8.0]))
+    got = m.get(jnp.asarray([1, 2, 1]))
+    assert got.shape == (3, 2)
+    np.testing.assert_allclose(np.asarray(got[:, 0]), [5.0, 7.0, 5.0])
+    # and on a batched memory, a (K, B) key stack gathers K per element
+    mb = CMemory.create(4, batch_shape=(2,))
+    mb = mb.set_(jnp.asarray([0, 1]), jnp.asarray([1.0, 2.0]))
+    got = mb.get(jnp.asarray([[0, 1], [1, 0]]))
+    assert got.shape == (2, 2)
+    np.testing.assert_allclose(np.asarray(got), [[1.0, 2.0], [0.0, 0.0]])
+
+
+def test_clist_unbatched_array_index_gather():
+    lst = CList.create(4).append_(1.0).append_(2.0).append_(3.0)
+    got = lst.get(jnp.asarray([0, 2, -1]))
+    np.testing.assert_allclose(np.asarray(got), [1.0, 3.0, 3.0])

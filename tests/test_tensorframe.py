@@ -83,3 +83,92 @@ def test_to_pandas():
     df = make_frame().without_columns("values").to_pandas()
     assert list(df.columns) == ["fitness", "tag"]
     assert len(df) == 3
+
+
+# -- reference-parity surface: pick[rows, cols], pick_set, joins, each -------
+
+
+def test_pick_rows_and_columns():
+    f = make_frame()
+    sub = f.pick[jnp.asarray([0, 2]), "fitness"]
+    assert sub.column_names == ("fitness",)
+    assert np.asarray(sub["fitness"]).tolist() == [3.0, 2.0]
+    sub2 = f.pick[jnp.asarray([True, False, True]), ["fitness", "tag"]]
+    assert sub2.column_names == ("fitness", "tag")
+    assert len(sub2) == 2
+    sub3 = f.pick[1:, :]
+    assert len(sub3) == 2 and sub3.column_names == f.column_names
+
+
+def test_pick_set_functional_assignment():
+    f = make_frame()
+    # index-array write to one column
+    f2 = f.pick_set(jnp.asarray([0, 1]), jnp.asarray([9.0, 8.0]), columns="fitness")
+    assert np.asarray(f2["fitness"]).tolist() == [9.0, 8.0, 2.0]
+    assert np.asarray(f["fitness"]).tolist() == [3.0, 1.0, 2.0]  # original intact
+    # boolean-mask write via a mapping (jit/vmap-safe form)
+    f3 = f.pick_set(
+        jnp.asarray([True, False, True]),
+        {"fitness": 0.0, "tag": jnp.asarray(7)},
+    )
+    assert np.asarray(f3["fitness"]).tolist() == [0.0, 1.0, 0.0]
+    assert np.asarray(f3["tag"]).tolist() == [7, 1, 7]
+    # frame right-hand side + slice rows
+    f4 = f.pick_set(slice(0, 2), TensorFrame.create(fitness=jnp.asarray([5.0, 5.0])))
+    assert np.asarray(f4["fitness"]).tolist() == [5.0, 5.0, 2.0]
+    # in-place pick assignment is rejected with a pointer to pick_set
+    with pytest.raises(TypeError, match="pick_set"):
+        f.pick[jnp.asarray([0])] = 1.0
+
+
+def test_pick_set_under_jit_with_mask():
+    f = make_frame()
+
+    @jax.jit
+    def zero_where_tagged(frame):
+        return frame.pick_set(frame["tag"] == 0, jnp.asarray(0.0), columns="fitness")
+
+    out = zero_where_tagged(f)
+    assert np.asarray(out["fitness"]).tolist() == [0.0, 1.0, 0.0]
+
+
+def test_hstack_join_drop():
+    f = make_frame()
+    g = TensorFrame.create(extra=jnp.asarray([10.0, 20.0, 30.0]))
+    joined = f.join(g)
+    assert joined.column_names == ("fitness", "values", "tag", "extra")
+    with pytest.raises(ValueError, match="override"):
+        f.hstack(f)
+    overridden = f.hstack(
+        TensorFrame.create(fitness=jnp.zeros(3)), override=True
+    )
+    assert np.asarray(overridden["fitness"]).tolist() == [0.0, 0.0, 0.0]
+    dropped = joined.drop(columns=["values", "extra"])
+    assert dropped.column_names == ("fitness", "tag")
+    with pytest.raises(ValueError, match="unknown"):
+        f.drop(columns="nope")
+
+
+def test_vstack_argsort_nlargest():
+    f = make_frame()
+    assert len(f.vstack(f)) == 6
+    assert np.asarray(f.argsort("fitness")).tolist() == [1, 2, 0]
+    top2 = f.nlargest(2, "fitness")
+    assert np.asarray(top2["fitness"]).tolist() == [3.0, 2.0]
+    bottom = f.nsmallest(1, "fitness")
+    assert np.asarray(bottom["fitness"]).tolist() == [1.0]
+    assert np.asarray(f.sort("fitness")["fitness"]).tolist() == [1.0, 2.0, 3.0]
+
+
+def test_each_vmapped_rowwise():
+    f = make_frame()
+    out = f.each(lambda row: {"double": row["fitness"] * 2})
+    assert out.column_names == ("double",)
+    assert np.asarray(out["double"]).tolist() == [6.0, 2.0, 4.0]
+    joined = f.each(
+        lambda row: {"fitness": row["fitness"] + row["tag"]}, join=True, override=True
+    )
+    assert np.asarray(joined["fitness"]).tolist() == [3.0, 2.0, 2.0]
+    assert "values" in joined.column_names
+    with pytest.raises(ValueError, match="join"):
+        f.each(lambda row: {"x": row["tag"]}, override=True)
