@@ -16,6 +16,7 @@ brax env when brax is installed (import-gated), mirroring the reference's
 from .base import Env, EnvState, Space
 from .classic import Acrobot, CartPole, MountainCarContinuous, Pendulum, Swimmer2D
 from .hopper import Hopper
+from .ant import Ant
 from .humanoid import Humanoid
 from .registry import make_env, register_env
 
@@ -30,6 +31,7 @@ __all__ = [
     "Swimmer2D",
     "Hopper",
     "Humanoid",
+    "Ant",
     "make_env",
     "register_env",
 ]

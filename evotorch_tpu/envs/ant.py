@@ -1,0 +1,129 @@
+"""Ant: quadruped locomotion on the maximal-coordinates engine (8 DOF).
+
+A MuJoCo-Ant-class quadruped: a central torso sphere and four legs (upper
+link extending horizontally along +x/+y/-x/-y, lower link dropping to a foot
+sphere), 8 joints carrying 8 actuated rotational DOF (per leg: hip swing
+about z, knee lift about the horizontal axis perpendicular to the leg).
+Observation: the standard locomotion layout of
+:class:`RigidBodyLocomotionEnv` (79-dim here: 8 joint angle/velocity pairs,
+8 non-torso bodies, 4 foot contact depths). Reward mirrors ``Ant-v4``:
+forward velocity + alive bonus - control cost, terminating outside the
+healthy height band.
+
+This is the second body plan on the engine (after ``humanoid.py``) and the
+classic Brax showcase task the reference reaches only through the external
+dlpack bridge (``/root/reference/src/evotorch/neuroevolution/net/
+vecrl.py:1366-1490``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .locomotion import RigidBodyLocomotionEnv
+from .rigidbody import SystemBuilder, capsule_inertia, sphere_inertia
+
+__all__ = ["Ant"]
+
+
+def _build_ant(act_mode: str = "position"):
+    b = SystemBuilder(
+        omega_pos=200.0,
+        omega_ang=200.0,
+        zeta=1.0,
+        limit_gain=4.0,
+        tone_ratio=0.1,
+        free_damping_ratio=0.1,
+        contact_k=15_000.0,
+        contact_c=300.0,
+        friction_mu=1.0,
+        tangent_damping=300.0,
+        act_mode=act_mode,
+        # stronger servos than the inertia-scaled default: leg links are
+        # light, so act_kp would otherwise lose to gravity torques
+        act_kp_ratio=2.0,
+    )
+
+    # Bodies: torso sphere + 4 legs; reference pose with legs extended
+    # horizontally and lower legs vertical down to the feet. z up, ground 0.
+    z0 = 0.55
+    b.add_body("torso", (0, 0, z0), 10.0, sphere_inertia(10.0, 0.25))
+    # leg directions: +x, +y, -x, -y; per-leg body/axis naming
+    dirs = {"front": (1.0, 0.0), "left": (0.0, 1.0), "back": (-1.0, 0.0), "right": (0.0, -1.0)}
+    for name, (dx, dy) in dirs.items():
+        horizontal = "x" if dx != 0.0 else "y"  # upper-leg long axis
+        ux, uy = 0.425 * dx, 0.425 * dy  # upper-leg COM (hip at 0.25, len 0.35)
+        b.add_body(
+            f"{name}_upper",
+            (ux, uy, z0),
+            1.5,
+            capsule_inertia(1.5, 0.05, 0.35, horizontal),
+        )
+        lx, ly = 0.6 * dx, 0.6 * dy  # lower leg hangs from the knee at 0.6
+        b.add_body(
+            f"{name}_lower",
+            (lx, ly, z0 - 0.21),
+            1.2,
+            capsule_inertia(1.2, 0.04, 0.42, "z"),
+        )
+
+    # Joints: per leg, hip swing about z + knee lift about the horizontal
+    # axis perpendicular to the leg direction (both world-aligned in the
+    # reference pose, as the engine's axis/inertia pairing assumes) —
+    # 2 actuated DOF per leg, the Ant-v4 budget.
+    for name, (dx, dy) in dirs.items():
+        lift_axis = "y" if dx != 0.0 else "x"
+        b.add_joint(
+            "torso",
+            f"{name}_upper",
+            (0.25 * dx, 0.25 * dy, z0),
+            free_axes=("z",),
+            limits=[(-0.6, 0.6)],
+            gears=(40.0,),
+            tone=40.0,  # posture support (see humanoid's posture joints)
+        )
+        b.add_joint(
+            f"{name}_upper",
+            f"{name}_lower",
+            (0.6 * dx, 0.6 * dy, z0),
+            free_axes=(lift_axis,),
+            limits=[(-0.9, 0.9)],
+            gears=(60.0,),
+            tone=40.0,
+        )
+
+    # Colliders: the four feet first (their contact depths are observed),
+    # then the torso.
+    for name, (dx, dy) in dirs.items():
+        b.add_sphere(f"{name}_lower", (0.6 * dx, 0.6 * dy, z0 - 0.44), 0.08)
+    b.add_sphere("torso", (0, 0, z0), 0.25)
+
+    return b.build()
+
+
+class Ant(RigidBodyLocomotionEnv):
+    """Quadruped locomotion; ``Ant-v4``-style reward and DOF budget:
+    8 actuated DOF over 8 joints (per leg: hip swing about z, knee lift
+    about the horizontal axis perpendicular to the leg)."""
+
+    def __init__(
+        self,
+        *,
+        forward_reward_weight: float = 1.0,
+        alive_bonus: float = 1.0,
+        ctrl_cost_weight: float = 0.5,
+        healthy_z_range=(0.2, 1.0),
+        reset_noise_scale: float = 0.01,
+        act_mode: str = "position",
+        dt: float = 0.015,
+        substeps: int = 8,
+    ):
+        self.sys, self._default_pos = _build_ant(act_mode)
+        self.dt = float(dt)
+        self.substeps = int(substeps)
+        self.forward_reward_weight = forward_reward_weight
+        self.alive_bonus = alive_bonus
+        self.ctrl_cost_weight = ctrl_cost_weight
+        self.healthy_z_range = healthy_z_range
+        self.reset_noise_scale = reset_noise_scale
+        self._finalize_spaces()

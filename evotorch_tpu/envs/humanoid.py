@@ -19,26 +19,8 @@ rolls out inside one XLA program.
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-
-import numpy as np
-
-from ..tools.pytree import replace
-from .base import Env, EnvState, Space
-from .rigidbody import (
-    BodyState,
-    SystemBuilder,
-    capsule_inertia,
-    joint_angles,
-    joint_velocities,
-    joint_angles_batched,
-    joint_velocities_batched,
-    physics_step,
-    physics_step_batched,
-    sphere_penetrations,
-    sphere_penetrations_batched,
-)
+from .locomotion import RigidBodyLocomotionEnv
+from .rigidbody import SystemBuilder, capsule_inertia
 
 __all__ = ["Humanoid"]
 
@@ -133,22 +115,11 @@ def _build_humanoid(act_mode: str = "position"):
     return b.build()
 
 
-class Humanoid(Env):
-    """3-D humanoid locomotion. Observation (109-dim):
-
-    ====== =====================================================
-    dims   content
-    ====== =====================================================
-    1      torso height
-    4      torso orientation quaternion
-    3      torso linear velocity (world)
-    3      torso angular velocity (world)
-    17     joint angles (action-DOF order)
-    17     joint angular velocities (action-DOF order)
-    30     non-torso body COM positions relative to the torso
-    30     non-torso body velocities relative to the torso
-    4      foot contact depths (right heel/toe, left heel/toe)
-    ====== =====================================================
+class Humanoid(RigidBodyLocomotionEnv):
+    """3-D humanoid locomotion (the flagship workload). Observation: the
+    standard locomotion layout of :class:`RigidBodyLocomotionEnv` (109-dim
+    here: 17 joint angle/velocity pairs, 10 non-torso bodies, 4 foot contact
+    depths — right heel/toe, left heel/toe).
 
     Action: 17 values in ``[-1, 1]``. With the default ``act_mode="position"``
     they are PD servo targets (0 = reference pose, +/-1 = joint limits,
@@ -157,10 +128,6 @@ class Humanoid(Env):
     Reward: ``1.25 * forward_velocity + 5.0 - 0.1 * ||action||^2`` while the
     torso stays in the healthy height band, mirroring ``Humanoid-v4``.
     """
-
-    max_episode_steps = 1000
-    # the hot path: population-minor physics (rigidbody.py layout note)
-    batched_native = True
 
     def __init__(
         self,
@@ -171,6 +138,8 @@ class Humanoid(Env):
         healthy_z_range=(0.85, 1.75),
         reset_noise_scale: float = 0.01,
         act_mode: str = "position",
+        dt: float = 0.015,
+        substeps: int = 8,
     ):
         """``act_mode="position"`` (default): actions are PD target angles —
         zero action actively holds the reference pose, which makes standing
@@ -178,186 +147,13 @@ class Humanoid(Env):
         Brax/MJX humanoid-training setups make). ``act_mode="torque"``
         reproduces the MuJoCo ``Humanoid-v4`` raw-torque semantics."""
         self.sys, self._default_pos = _build_humanoid(act_mode)
-        self.dt = 0.015
-        self.substeps = 8
+        # the default h = dt/substeps = 1.875ms keeps a ~5x margin from the
+        # integrator stability boundary; validated in _finalize_spaces
+        self.dt = float(dt)
+        self.substeps = int(substeps)
         self.forward_reward_weight = forward_reward_weight
         self.alive_bonus = alive_bonus
         self.ctrl_cost_weight = ctrl_cost_weight
         self.healthy_z_range = healthy_z_range
         self.reset_noise_scale = reset_noise_scale
-
-        na = self.sys.num_act
-        self.action_space = Space(shape=(na,), lb=-jnp.ones(na), ub=jnp.ones(na))
-        self.observation_space = Space(shape=(self._obs_dim(),))
-
-        # static selection matrix flattening per-joint axis components
-        # (nj, 3) -> the action-DOF order; batched _free_components is then a
-        # dense (na, nj*3) x (nj*3, B) matmul instead of a scatter
-        nj = self.sys.num_joints
-        idx = np.asarray(self.sys.act_index).reshape(-1)  # (nj*3,)
-        sel = np.zeros((na, nj * 3), dtype=np.float32)
-        for flat_pos, a in enumerate(idx):
-            if a < na:
-                sel[a, flat_pos] = 1.0
-        self._free_sel = jnp.asarray(sel)
-
-    def _obs_dim(self) -> int:
-        nb = self.sys.num_bodies
-        return 1 + 4 + 3 + 3 + self.sys.num_act + self.sys.num_act + 2 * 3 * (nb - 1) + 4
-
-    # -- helpers -----------------------------------------------------------
-    def _free_components(self, comps: jnp.ndarray) -> jnp.ndarray:
-        """Flatten per-joint axis components ``(nj, 3)`` to the 17-dim action
-        layout using the builder's action-index map."""
-        idx = self.sys.act_index  # (nj, 3) with num_act marking unactuated
-        # invert the map: out[idx[j, a]] = comps[j, a]; unactuated axes all
-        # land on the extra scratch slot, which is dropped
-        out = jnp.zeros(self.sys.num_act + 1, comps.dtype)
-        out = out.at[idx.reshape(-1)].set(comps.reshape(-1))
-        return out[: self.sys.num_act]
-
-    def _obs(self, st: BodyState) -> jnp.ndarray:
-        torso_pos = st.pos[0]
-        rel_pos = (st.pos[1:] - torso_pos).reshape(-1)
-        rel_vel = (st.vel[1:] - st.vel[0]).reshape(-1)
-        ja = self._free_components(joint_angles(self.sys, st))
-        jv = self._free_components(joint_velocities(self.sys, st))
-        feet = sphere_penetrations(self.sys, st)[:4]
-        return jnp.concatenate(
-            [
-                torso_pos[2:3],
-                st.quat[0],
-                st.vel[0],
-                st.ang[0],
-                ja,
-                jv,
-                rel_pos,
-                rel_vel,
-                feet,
-            ]
-        )
-
-    # -- batched-native protocol (population-minor state layout) -----------
-    def _batch_free_components(self, comps: jnp.ndarray) -> jnp.ndarray:
-        """``(nj, 3, B)`` axis components -> ``(na, B)`` action-DOF order."""
-        nj = self.sys.num_joints
-        return self._free_sel @ comps.reshape(nj * 3, -1)
-
-    def _batch_obs(self, st: BodyState) -> jnp.ndarray:
-        """Observation for a population state ``(nb, comp, B)`` -> ``(B, obs)``.
-        Field order matches :meth:`_obs` exactly."""
-        B = st.pos.shape[-1]
-        ja = self._batch_free_components(joint_angles_batched(self.sys, st))
-        jv = self._batch_free_components(joint_velocities_batched(self.sys, st))
-        obs = jnp.concatenate(
-            [
-                st.pos[0, 2:3, :],  # torso height (1, B)
-                st.quat[0],  # (4, B)
-                st.vel[0],  # (3, B)
-                st.ang[0],  # (3, B)
-                ja,  # (na, B)
-                jv,  # (na, B)
-                (st.pos[1:] - st.pos[:1]).reshape(-1, B),
-                (st.vel[1:] - st.vel[:1]).reshape(-1, B),
-                sphere_penetrations_batched(self.sys, st)[:4],  # feet (4, B)
-            ],
-            axis=0,
-        )
-        return obs.T
-
-    def batch_reset(self, keys):
-        """Reset ``B`` lanes at once; ``keys`` is a ``(B,)`` key array."""
-        B = keys.shape[0]
-        nb = self.sys.num_bodies
-        split = jax.vmap(lambda k: jax.random.split(k, 3))(keys)  # (B, 3) keys
-        noise = self.reset_noise_scale
-        vel = noise * jax.vmap(lambda k: jax.random.normal(k, (nb, 3)))(split[:, 1])
-        ang = noise * jax.vmap(lambda k: jax.random.normal(k, (nb, 3)))(split[:, 2])
-        st = BodyState(
-            pos=jnp.broadcast_to(self._default_pos[..., None], (nb, 3, B)),
-            quat=jnp.broadcast_to(
-                jnp.asarray([1.0, 0.0, 0.0, 0.0])[None, :, None], (nb, 4, B)
-            ),
-            vel=jnp.moveaxis(vel, 0, -1),
-            ang=jnp.moveaxis(ang, 0, -1),
-        )
-        state = EnvState(
-            obs_state=st, t=jnp.zeros((B,), jnp.int32), key=split[:, 0]
-        )
-        return state, self._batch_obs(st)
-
-    def batch_step(self, state: EnvState, actions):
-        """Step ``B`` lanes: ``actions`` ``(B, na)`` -> leading-batch outputs."""
-        actions = jnp.clip(actions, self.action_space.lb, self.action_space.ub)
-        a = actions.T  # (na, B): population-minor for the physics
-        st = physics_step_batched(self.sys, state.obs_state, a, self.dt, self.substeps)
-        t = state.t + 1
-
-        z = st.pos[0, 2, :]
-        lo, hi = self.healthy_z_range
-        unhealthy = (z < lo) | (z > hi)
-        done = unhealthy | (t >= self.max_episode_steps)
-
-        forward_vel = st.vel[0, 0, :]
-        ctrl_cost = self.ctrl_cost_weight * jnp.sum(a * a, axis=0)
-        reward = self.forward_reward_weight * forward_vel + self.alive_bonus - ctrl_cost
-        reward = jnp.where(unhealthy, reward - self.alive_bonus, reward)
-
-        return replace(state, obs_state=st, t=t), self._batch_obs(st), reward, done
-
-    def batch_where(self, mask, a: EnvState, b: EnvState) -> EnvState:
-        """Per-lane state select: lane i takes ``a`` where ``mask[i]`` else
-        ``b`` (the rollout driver's auto-reset). Field-explicit — the body
-        state is batch-trailing while ``t``/``key`` are batch-leading, so a
-        generic shape-sniffing tree_map would be ambiguous."""
-        obs_state = jax.tree_util.tree_map(
-            lambda x, y: jnp.where(mask[None, None, :], x, y),
-            a.obs_state,
-            b.obs_state,
-        )
-        t = jnp.where(mask, a.t, b.t)
-        ka, kb = a.key, b.key
-        if jnp.issubdtype(ka.dtype, jax.dtypes.prng_key):
-            kd = jnp.where(
-                mask[:, None], jax.random.key_data(ka), jax.random.key_data(kb)
-            )
-            key = jax.random.wrap_key_data(kd)
-        else:  # legacy raw uint32 keys, (B, 2)
-            key = jnp.where(mask[:, None], ka, kb)
-        return EnvState(obs_state=obs_state, t=t, key=key)
-
-    # -- Env protocol ------------------------------------------------------
-    def reset(self, key):
-        key, k1, k2 = jax.random.split(key, 3)
-        nb = self.sys.num_bodies
-        noise = self.reset_noise_scale
-        vel = noise * jax.random.normal(k1, (nb, 3))
-        ang = noise * jax.random.normal(k2, (nb, 3))
-        st = BodyState(
-            pos=self._default_pos,
-            quat=jnp.tile(jnp.asarray([1.0, 0.0, 0.0, 0.0]), (nb, 1)),
-            vel=vel,
-            ang=ang,
-        )
-        return EnvState(obs_state=st, t=jnp.zeros((), jnp.int32), key=key), self._obs(st)
-
-    def step(self, state: EnvState, action):
-        action = jnp.clip(
-            jnp.reshape(action, (self.sys.num_act,)),
-            self.action_space.lb,
-            self.action_space.ub,
-        )
-        st = physics_step(self.sys, state.obs_state, action, self.dt, self.substeps)
-        t = state.t + 1
-
-        z = st.pos[0, 2]
-        lo, hi = self.healthy_z_range
-        unhealthy = (z < lo) | (z > hi)
-        done = unhealthy | (t >= self.max_episode_steps)
-
-        forward_vel = st.vel[0, 0]
-        ctrl_cost = self.ctrl_cost_weight * jnp.sum(action**2)
-        reward = self.forward_reward_weight * forward_vel + self.alive_bonus - ctrl_cost
-        reward = jnp.where(unhealthy, reward - self.alive_bonus, reward)
-
-        return replace(state, obs_state=st, t=t), self._obs(st), reward, done
+        self._finalize_spaces()

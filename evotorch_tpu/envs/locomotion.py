@@ -1,0 +1,234 @@
+"""Shared base for rigid-body locomotion environments (batched-native).
+
+Everything the maximal-coordinates engine needs to expose a locomotion task
+lives here once: population-minor ``batch_reset`` / ``batch_step`` /
+``batch_where`` (the ``Env.batched_native`` protocol the rollout engine
+prefers — see ``rigidbody.py``'s layout note), the standard MuJoCo-style
+reward (forward velocity + alive bonus - control cost, terminating outside a
+healthy height band), and the common observation layout:
+
+====================  =====================================================
+dims                  content
+====================  =====================================================
+1                     torso height
+4                     torso orientation quaternion
+3                     torso linear velocity (world)
+3                     torso angular velocity (world)
+num_act               joint angles (action-DOF order)
+num_act               joint angular velocities (action-DOF order)
+3 * (num_bodies - 1)  non-torso body COM positions relative to the torso
+3 * (num_bodies - 1)  non-torso body velocities relative to the torso
+n_contact_obs         ground contact depths of the first collider spheres
+====================  =====================================================
+
+The single-instance ``reset``/``step`` API is the B=1 special case of the
+batched protocol, so each concrete env carries exactly one implementation of
+its dynamics, observation and reward. Subclasses provide the body plan
+(a built ``System`` + default pose) and the task constants.
+
+Parity note: the reference reaches this workload class only through external
+Brax (``/root/reference/src/evotorch/neuroevolution/net/vecrl.py:1366-1490``);
+here the simulator is native, so whole populations roll out inside one XLA
+program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tools.pytree import replace
+from .base import Env, EnvState, Space
+from .rigidbody import (
+    BodyState,
+    joint_angles_batched,
+    joint_velocities_batched,
+    physics_step_batched,
+    sphere_penetrations_batched,
+)
+
+__all__ = ["RigidBodyLocomotionEnv"]
+
+
+class RigidBodyLocomotionEnv(Env):
+    """Base class: subclasses set ``sys``/``_default_pos`` (the body plan),
+    ``dt``/``substeps``, reward weights and ``n_contact_obs`` before calling
+    ``_finalize_spaces()``."""
+
+    batched_native = True
+    max_episode_steps = 1000
+    n_contact_obs = 4
+    # largest per-substep h the default joint stiffness tolerates; the
+    # semi-implicit Euler boundary is h * omega < 2 and the stiffest default
+    # constraint frequency is omega ~= 250 rad/s, so 8ms keeps a safe margin
+    integrator_h_budget = 0.008
+
+    # reward constants (MuJoCo locomotion family defaults; subclasses override)
+    forward_reward_weight = 1.25
+    alive_bonus = 5.0
+    ctrl_cost_weight = 0.1
+    healthy_z_range = (0.2, 2.0)
+    reset_noise_scale = 0.01
+
+    # -- construction helpers ------------------------------------------------
+    def _finalize_spaces(self):
+        """Derive action/observation spaces + the static action-DOF selection
+        matrix from the built system, and validate the integrator step.
+        Call at the end of ``__init__``."""
+        if self.substeps < 1:
+            raise ValueError(f"substeps must be >= 1, got {self.substeps}")
+        if self.dt / self.substeps > self.integrator_h_budget:
+            raise ValueError(
+                f"dt/substeps = {self.dt / self.substeps:.4f}s exceeds the"
+                f" integrator stability budget ({self.integrator_h_budget}s"
+                " at the default joint stiffness); increase substeps or"
+                " lower dt"
+            )
+        na = self.sys.num_act
+        self.action_space = Space(shape=(na,), lb=-jnp.ones(na), ub=jnp.ones(na))
+        self.observation_space = Space(shape=(self._obs_dim(),))
+
+        # static selection matrix flattening per-joint axis components
+        # (nj, 3) -> the action-DOF order; _batch_free_components is then a
+        # dense (na, nj*3) x (nj*3, B) matmul instead of a scatter
+        nj = self.sys.num_joints
+        idx = np.asarray(self.sys.act_index).reshape(-1)  # (nj*3,)
+        sel = np.zeros((na, nj * 3), dtype=np.float32)
+        for flat_pos, a in enumerate(idx):
+            if a < na:
+                sel[a, flat_pos] = 1.0
+        self._free_sel = jnp.asarray(sel)
+
+    def _obs_dim(self) -> int:
+        nb = self.sys.num_bodies
+        na = self.sys.num_act
+        return 1 + 4 + 3 + 3 + 2 * na + 2 * 3 * (nb - 1) + self.n_contact_obs
+
+    # -- observation ---------------------------------------------------------
+    def _batch_free_components(self, comps: jnp.ndarray) -> jnp.ndarray:
+        """``(nj, 3, B)`` axis components -> ``(na, B)`` action-DOF order."""
+        nj = self.sys.num_joints
+        return self._free_sel @ comps.reshape(nj * 3, -1)
+
+    def _batch_obs(self, st: BodyState) -> jnp.ndarray:
+        """Observation for a population state ``(nb, comp, B)`` -> ``(B, obs)``."""
+        B = st.pos.shape[-1]
+        ja = self._batch_free_components(joint_angles_batched(self.sys, st))
+        jv = self._batch_free_components(joint_velocities_batched(self.sys, st))
+        obs = jnp.concatenate(
+            [
+                st.pos[0, 2:3, :],  # torso height (1, B)
+                st.quat[0],  # (4, B)
+                st.vel[0],  # (3, B)
+                st.ang[0],  # (3, B)
+                ja,  # (na, B)
+                jv,  # (na, B)
+                (st.pos[1:] - st.pos[:1]).reshape(-1, B),
+                (st.vel[1:] - st.vel[:1]).reshape(-1, B),
+                sphere_penetrations_batched(self.sys, st)[: self.n_contact_obs],
+            ],
+            axis=0,
+        )
+        return obs.T
+
+    # -- reward / termination (override for task variants) -------------------
+    def _batch_reward_done(self, st: BodyState, actions_minor: jnp.ndarray, t):
+        """``actions_minor`` is ``(na, B)`` (clipped). Returns
+        ``(reward (B,), done (B,))``."""
+        z = st.pos[0, 2, :]
+        lo, hi = self.healthy_z_range
+        unhealthy = (z < lo) | (z > hi)
+        done = unhealthy | (t >= self.max_episode_steps)
+
+        forward_vel = st.vel[0, 0, :]
+        ctrl_cost = self.ctrl_cost_weight * jnp.sum(actions_minor * actions_minor, axis=0)
+        reward = self.forward_reward_weight * forward_vel + self.alive_bonus - ctrl_cost
+        reward = jnp.where(unhealthy, reward - self.alive_bonus, reward)
+        return reward, done
+
+    # -- batched-native protocol ---------------------------------------------
+    def batch_reset(self, keys):
+        """Reset ``B`` lanes at once; ``keys`` is a ``(B,)`` key array."""
+        B = keys.shape[0]
+        nb = self.sys.num_bodies
+        split = jax.vmap(lambda k: jax.random.split(k, 3))(keys)  # (B, 3) keys
+        noise = self.reset_noise_scale
+        vel = noise * jax.vmap(lambda k: jax.random.normal(k, (nb, 3)))(split[:, 1])
+        ang = noise * jax.vmap(lambda k: jax.random.normal(k, (nb, 3)))(split[:, 2])
+        st = BodyState(
+            pos=jnp.broadcast_to(self._default_pos[..., None], (nb, 3, B)),
+            quat=jnp.broadcast_to(
+                jnp.asarray([1.0, 0.0, 0.0, 0.0])[None, :, None], (nb, 4, B)
+            ),
+            vel=jnp.moveaxis(vel, 0, -1),
+            ang=jnp.moveaxis(ang, 0, -1),
+        )
+        state = EnvState(obs_state=st, t=jnp.zeros((B,), jnp.int32), key=split[:, 0])
+        return state, self._batch_obs(st)
+
+    def batch_step(self, state: EnvState, actions):
+        """Step ``B`` lanes: ``actions`` ``(B, na)`` -> leading-batch outputs."""
+        actions = jnp.clip(actions, self.action_space.lb, self.action_space.ub)
+        a = actions.T  # (na, B): population-minor for the physics
+        st = physics_step_batched(self.sys, state.obs_state, a, self.dt, self.substeps)
+        t = state.t + 1
+        reward, done = self._batch_reward_done(st, a, t)
+        return replace(state, obs_state=st, t=t), self._batch_obs(st), reward, done
+
+    def batch_where(self, mask, a: EnvState, b: EnvState) -> EnvState:
+        """Per-lane state select: lane i takes ``a`` where ``mask[i]`` else
+        ``b`` (the rollout driver's auto-reset). Field-explicit — the body
+        state is batch-trailing while ``t``/``key`` are batch-leading, so a
+        generic shape-sniffing tree_map would be ambiguous."""
+        obs_state = jax.tree_util.tree_map(
+            lambda x, y: jnp.where(mask[None, None, :], x, y),
+            a.obs_state,
+            b.obs_state,
+        )
+        t = jnp.where(mask, a.t, b.t)
+        ka, kb = a.key, b.key
+        if jnp.issubdtype(ka.dtype, jax.dtypes.prng_key):
+            kd = jnp.where(
+                mask[:, None], jax.random.key_data(ka), jax.random.key_data(kb)
+            )
+            key = jax.random.wrap_key_data(kd)
+        else:  # legacy raw uint32 keys, (B, 2)
+            key = jnp.where(mask[:, None], ka, kb)
+        return EnvState(obs_state=obs_state, t=t, key=key)
+
+    # -- single-instance API: the B=1 special case ---------------------------
+    @staticmethod
+    def _key_as_batch(key) -> jnp.ndarray:
+        """One PRNG key -> a (1,)-batch of keys; legacy raw uint32 keys (a
+        ``(2,)`` array) become a ``(1, 2)`` batch."""
+        key = jnp.asarray(key)
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+            return jnp.reshape(key, (1,))
+        return jnp.reshape(key, (1, -1))
+
+    def _to_single(self, state: EnvState) -> EnvState:
+        st = state.obs_state
+        return EnvState(
+            obs_state=BodyState(*(x[..., 0] for x in st)),
+            t=state.t[0],
+            key=state.key[0],
+        )
+
+    def _to_batched(self, state: EnvState) -> EnvState:
+        st = state.obs_state
+        return EnvState(
+            obs_state=BodyState(*(x[..., None] for x in st)),
+            t=state.t[None],
+            key=self._key_as_batch(state.key),
+        )
+
+    def reset(self, key):
+        state, obs = self.batch_reset(self._key_as_batch(key))
+        return self._to_single(state), obs[0]
+
+    def step(self, state: EnvState, action):
+        bstate, obs, reward, done = self.batch_step(
+            self._to_batched(state), jnp.reshape(action, (1, -1))
+        )
+        return self._to_single(bstate), obs[0], reward[0], done[0]

@@ -59,5 +59,9 @@ def _register_defaults():
 
     register_env("humanoid", Humanoid)
 
+    from .ant import Ant
+
+    register_env("ant", Ant)
+
 
 _register_defaults()
