@@ -78,13 +78,16 @@ def main():
     # reproduces the previously-benchmarked 6-link swimmer)
     env_kwargs = json.loads(os.environ.get("BENCH_ENV_ARGS", "{}"))
     env = make_env(env_name, **env_kwargs)
-    net = (
-        Linear(env.observation_size, 64)
-        >> Tanh()
-        >> Linear(64, 64)
-        >> Tanh()
-        >> Linear(64, env.action_size)
-    )
+    # BENCH_HIDDEN: comma-separated hidden widths (default "64,64") — the
+    # MXU-headroom knob: ES rollouts are env-bound, so the policy can grow
+    # orders of magnitude before it shows up in steps/s
+    hidden = [
+        int(h) for h in os.environ.get("BENCH_HIDDEN", "64,64").split(",") if h
+    ]
+    net = Linear(env.observation_size, hidden[0])
+    for a, b in zip(hidden, hidden[1:] + [None]):
+        net = net >> Tanh()
+        net = net >> Linear(a, b if b is not None else env.action_size)
     policy = FlatParamsPolicy(net)
     print(
         f"devices={jax.devices()} popsize={popsize} params={policy.parameter_count} "
