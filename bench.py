@@ -10,85 +10,46 @@ matching the reference's Brax Humanoid north star; see BASELINE.md:
 >1M env-steps/sec). ``BENCH_ENV`` selects any registered env
 (e.g. ``hopper`` reproduces the round-1 SLIP-hopper numbers).
 
+BOTH evaluation contracts are measured every run (VERDICT r2 #1): the
+throughput-optimal ``budget`` contract and the reference's own ``episodes``
+contract, the latter through the lane-compacting runner. ``BENCH_EVAL_MODE``
+picks which one is the line's primary ``value``.
+
 ``vs_baseline`` = env_steps_per_sec / 1_000_000 (the north-star target).
 """
 
 import json
 import os
-import subprocess
 import sys
 import time
+from functools import partial
 
-
-def _tpu_healthy() -> bool:
-    """Probe backend init in a subprocess: the axon plugin can hang forever
-    when its tunnel is unhealthy, which must not stall the benchmark driver."""
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-            timeout=120,
-            capture_output=True,
-        )
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+from bench_common import bench_config, build_policy, fresh_pgpe_state, setup_backend
 
 
 def main():
-    use_cpu = not _tpu_healthy()
-    if use_cpu:
-        print("TPU backend unhealthy; falling back to CPU", file=sys.stderr)
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-        ).strip()
-
+    use_cpu = setup_backend()
     import jax
-
-    if use_cpu:
-        jax.config.update("jax_platforms", "cpu")
-
     import jax.numpy as jnp
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-    from evotorch_tpu.algorithms.functional import pgpe, pgpe_ask, pgpe_tell
+    from evotorch_tpu.algorithms.functional import pgpe_ask, pgpe_tell
     from evotorch_tpu.envs import make_env
-    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
     from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
-    from evotorch_tpu.neuroevolution.net.vecrl import run_vectorized_rollout
+    from evotorch_tpu.neuroevolution.net.vecrl import (
+        run_vectorized_rollout,
+        run_vectorized_rollout_compacting,
+    )
 
-    # on the CPU fallback, default to smaller sizes so the benchmark cannot
-    # stall the driver (popsize 10k x 200 steps is a TPU-sized program)
-    default_popsize = 1024 if use_cpu else 10_000
-    default_episode_length = 100 if use_cpu else 200
-    popsize = int(os.environ.get("BENCH_POPSIZE", default_popsize))
-    episode_length = int(os.environ.get("BENCH_EPISODE_LENGTH", default_episode_length))
-    generations = int(os.environ.get("BENCH_GENERATIONS", 3))
-    # opt-in: bf16 changes the measured compute dtype, so keep the default
-    # comparable with previously recorded f32 baselines
-    compute_dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
-    # "budget" (default): fixed interaction budget per lane with auto-reset —
-    # every lane active on every step, so every computed env step is a
-    # genuine counted interaction. "episodes" reproduces the reference's
-    # idle-when-done masking (conservative counting; see net/vecrl.py).
-    eval_mode = os.environ.get("BENCH_EVAL_MODE", "budget")
-
-    env_name = os.environ.get("BENCH_ENV", "humanoid")
-    # BENCH_ENV_ARGS: JSON kwargs for the env factory (e.g. '{"n_links": 6}'
-    # reproduces the previously-benchmarked 6-link swimmer)
-    env_kwargs = json.loads(os.environ.get("BENCH_ENV_ARGS", "{}"))
-    env = make_env(env_name, **env_kwargs)
-    # BENCH_HIDDEN: comma-separated hidden widths (default "64,64") — the
-    # MXU-headroom knob: ES rollouts are env-bound, so the policy can grow
-    # orders of magnitude before it shows up in steps/s
-    hidden = [
-        int(h) for h in os.environ.get("BENCH_HIDDEN", "64,64").split(",") if h
-    ]
-    net = Linear(env.observation_size, hidden[0])
-    for a, b in zip(hidden, hidden[1:] + [None]):
-        net = net >> Tanh()
-        net = net >> Linear(a, b if b is not None else env.action_size)
-    policy = FlatParamsPolicy(net)
+    cfg = bench_config(use_cpu)
+    popsize = cfg["popsize"]
+    episode_length = cfg["episode_length"]
+    generations = cfg["generations"]
+    compute_dtype = cfg["compute_dtype"]
+    eval_mode = cfg["eval_mode"]
+    env = make_env(cfg["env_name"], **cfg["env_kwargs"])
+    policy = build_policy(env)
     print(
         f"devices={jax.devices()} popsize={popsize} params={policy.parameter_count} "
         f"episode_length={episode_length} compute_dtype={compute_dtype or 'float32'}",
@@ -96,66 +57,91 @@ def main():
     )
 
     stats = RunningNorm(env.observation_size).stats
-    state = pgpe(
-        center_init=jnp.zeros(policy.parameter_count, dtype=jnp.float32),
-        center_learning_rate=0.1,
-        stdev_learning_rate=0.1,
-        objective_sense="max",
-        stdev_init=0.1,
+    state = fresh_pgpe_state(policy.parameter_count)
+
+    rollout_kwargs = dict(
+        num_episodes=1,
+        episode_length=episode_length,
+        compute_dtype=compute_dtype,
     )
 
-    def generation(state, key):
-        k1, k2 = jax.random.split(key)
-        values = pgpe_ask(k1, state, popsize=popsize)
-        result = run_vectorized_rollout(
-            env,
-            policy,
-            values,
-            k2,
-            stats,
-            num_episodes=1,
-            episode_length=episode_length,
-            compute_dtype=compute_dtype,
-            eval_mode=eval_mode,
-        )
-        state = pgpe_tell(state, values, result.scores)
-        return state, result.total_steps, result.scores
+    def measure_mode(mode, state, key):
+        """Run warmup + ``generations`` timed generations of one contract;
+        returns (steps_per_sec, generations_per_sec, final state, key)."""
+        if mode == "episodes_compact":
+            ask_jit = jax.jit(partial(pgpe_ask, popsize=popsize))
+            tell_jit = jax.jit(pgpe_tell)
 
-    gen_jit = jax.jit(generation)
+            def gen(state, key, prewarm=False):
+                k1, k2 = jax.random.split(key)
+                values = ask_jit(k1, state)
+                result = run_vectorized_rollout_compacting(
+                    env, policy, values, k2, stats, prewarm=prewarm, **rollout_kwargs
+                )
+                state = tell_jit(state, values, result.scores)
+                return state, result.total_steps, result.scores
+
+            key, sub = jax.random.split(key)
+            state, steps, scores = gen(state, sub, prewarm=True)
+            jax.block_until_ready(scores)
+        else:
+
+            def generation(state, key):
+                k1, k2 = jax.random.split(key)
+                values = pgpe_ask(k1, state, popsize=popsize)
+                result = run_vectorized_rollout(
+                    env, policy, values, k2, stats, eval_mode=mode, **rollout_kwargs
+                )
+                state = pgpe_tell(state, values, result.scores)
+                return state, result.total_steps, result.scores
+
+            gen = jax.jit(generation)
+            key, sub = jax.random.split(key)
+            state, steps, scores = gen(state, sub)
+            jax.block_until_ready(scores)
+        print(f"[{mode}] compiled; warmup steps={int(steps)}", file=sys.stderr)
+
+        t0 = time.perf_counter()
+        total_steps = 0
+        for _ in range(generations):
+            key, sub = jax.random.split(key)
+            state, steps, scores = gen(state, sub)
+            jax.block_until_ready(scores)
+            total_steps += int(steps)
+        elapsed = time.perf_counter() - t0
+        print(
+            f"[{mode}] {generations} generations, {total_steps} env-steps in "
+            f"{elapsed:.2f}s; mean score {float(jnp.mean(scores)):.3f}",
+            file=sys.stderr,
+        )
+        return total_steps / elapsed, generations / elapsed, state, key
 
     key = jax.random.key(0)
-    # warmup/compile
-    key, sub = jax.random.split(key)
-    state, steps, scores = gen_jit(state, sub)
-    jax.block_until_ready(scores)
-    print(f"compiled; warmup steps={int(steps)}", file=sys.stderr)
+    modes = {}
+    secondary = "episodes_compact" if eval_mode == "budget" else "budget"
+    for mode in (eval_mode, secondary):
+        sps, gps, _, key = measure_mode(mode, state, key)
+        modes[mode] = {
+            "value": round(sps, 1),
+            "vs_baseline": round(sps / 1_000_000, 4),
+            "generations_per_sec": round(gps, 3),
+        }
 
-    t0 = time.perf_counter()
-    total_steps = 0
-    for _ in range(generations):
-        key, sub = jax.random.split(key)
-        state, steps, scores = gen_jit(state, sub)
-        jax.block_until_ready(scores)
-        total_steps += int(steps)
-    elapsed = time.perf_counter() - t0
-
-    steps_per_sec = total_steps / elapsed
-    generations_per_sec = generations / elapsed
-    print(
-        f"{generations} generations, {total_steps} env-steps in {elapsed:.2f}s; "
-        f"mean score {float(jnp.mean(scores)):.3f}",
-        file=sys.stderr,
-    )
+    primary = modes[eval_mode]
+    episodes_key = next((m for m in modes if m.startswith("episodes")), None)
     print(
         json.dumps(
             {
                 "metric": "pgpe_vectorized_rollout_env_steps_per_sec",
-                "value": round(steps_per_sec, 1),
+                "value": primary["value"],
                 "unit": "env_steps/sec",
-                "vs_baseline": round(steps_per_sec / 1_000_000, 4),
-                "generations_per_sec": round(generations_per_sec, 3),
-                "env": env_name,
-                "env_args": env_kwargs,
+                "vs_baseline": primary["vs_baseline"],
+                "generations_per_sec": primary["generations_per_sec"],
+                "episodes_mode_value": modes[episodes_key]["value"] if episodes_key else None,
+                "episodes_mode_vs_baseline": modes[episodes_key]["vs_baseline"] if episodes_key else None,
+                "modes": modes,
+                "env": cfg["env_name"],
+                "env_args": cfg["env_kwargs"],
                 "popsize": popsize,
                 "episode_length": episode_length,
                 "eval_mode": eval_mode,

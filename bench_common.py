@@ -1,0 +1,92 @@
+"""Shared scaffolding for bench.py and bench_multichip.py: the TPU health
+probe / CPU fallback dance, BENCH_* env-var parsing, and the policy builder —
+one place, so the two benchmarks cannot silently diverge."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def tpu_healthy() -> bool:
+    """Probe backend init in a subprocess: the axon plugin can hang forever
+    when its tunnel is unhealthy, which must not stall the benchmark driver."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            timeout=120,
+            capture_output=True,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def setup_backend() -> bool:
+    """Pick TPU when the tunnel is healthy, else an 8-virtual-device CPU.
+    Must run before jax's first device use. Returns use_cpu."""
+    requested_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    use_cpu = requested_cpu or not tpu_healthy()
+    if use_cpu:
+        if not requested_cpu:
+            print("TPU backend unhealthy; falling back to CPU", file=sys.stderr)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    if use_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    return use_cpu
+
+
+def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
+    """Parse the BENCH_* knobs (on the CPU fallback, defaults shrink so the
+    benchmark cannot stall the driver)."""
+    import jax.numpy as jnp
+
+    return {
+        "popsize": int(os.environ.get("BENCH_POPSIZE", 1024 if use_cpu else 10_000)),
+        "episode_length": int(
+            os.environ.get(
+                "BENCH_EPISODE_LENGTH", cpu_episode_length if use_cpu else 200
+            )
+        ),
+        "generations": int(os.environ.get("BENCH_GENERATIONS", 3)),
+        # opt-in bf16: changes the measured compute dtype, so the default
+        # stays comparable with previously recorded f32 baselines
+        "compute_dtype": (
+            jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
+        ),
+        "eval_mode": os.environ.get("BENCH_EVAL_MODE", "budget"),
+        "env_name": os.environ.get("BENCH_ENV", "humanoid"),
+        "env_kwargs": json.loads(os.environ.get("BENCH_ENV_ARGS", "{}")),
+    }
+
+
+def build_policy(env):
+    """The benchmark policy: an MLP sized by BENCH_HIDDEN (default "64,64" —
+    the MXU-headroom knob; ES rollouts are env-bound, so the policy can grow
+    orders of magnitude before it shows up in steps/s)."""
+    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+
+    hidden = [int(h) for h in os.environ.get("BENCH_HIDDEN", "64,64").split(",") if h]
+    net = Linear(env.observation_size, hidden[0])
+    for a, b in zip(hidden, hidden[1:] + [None]):
+        net = net >> Tanh()
+        net = net >> Linear(a, b if b is not None else env.action_size)
+    return FlatParamsPolicy(net)
+
+
+def fresh_pgpe_state(parameter_count: int):
+    import jax.numpy as jnp
+
+    from evotorch_tpu.algorithms.functional import pgpe
+
+    return pgpe(
+        center_init=jnp.zeros(parameter_count, dtype=jnp.float32),
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        objective_sense="max",
+        stdev_init=0.1,
+    )

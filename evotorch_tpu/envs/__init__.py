@@ -18,6 +18,8 @@ from .classic import Acrobot, CartPole, MountainCarContinuous, Pendulum, Swimmer
 from .hopper import Hopper
 from .ant import Ant
 from .humanoid import Humanoid
+from .walker2d import Walker2D
+from .halfcheetah import HalfCheetah
 from .registry import make_env, register_env
 
 __all__ = [
@@ -32,6 +34,8 @@ __all__ = [
     "Hopper",
     "Humanoid",
     "Ant",
+    "Walker2D",
+    "HalfCheetah",
     "make_env",
     "register_env",
 ]

@@ -49,6 +49,8 @@ class Env:
     - ``batch_step(states, actions) -> (states, obs, rewards, dones)`` with
       leading-batch ``(B, ...)`` actions/obs/rewards/dones
     - ``batch_where(mask, a, b)`` — per-lane state selection (auto-reset)
+    - ``batch_take(states, idx)`` — gather lanes by index (lane compaction;
+      required for ``run_vectorized_rollout_compacting``)
 
     and may lay out their *internal* state pytree however they like. The
     rollout engine calls these instead of ``vmap(step)``. The point is TPU

@@ -1,0 +1,122 @@
+"""HalfCheetah: planar galloper on the maximal-coordinates engine (6 DOF).
+
+A MuJoCo-HalfCheetah-class planar runner: a long horizontal torso with one
+back and one front leg (thigh / shin / foot each), 7 bodies and 6 actuated
+rotational DOF about y. Like the MuJoCo original the task is planar
+(``planar = True`` -> sagittal-plane projection, ``locomotion.py``) and
+**never terminates** — the cheetah is free to tumble; the episode runs its
+full length and reward is purely ``forward_velocity - ctrl_cost``
+(``HalfCheetah-v4`` semantics: no alive bonus, no healthy band).
+
+Part of the BASELINE.md recipe-environment coverage (reference
+``examples/scripts/rl_clipup.py``); the reference reaches it through
+gym/MuJoCo, this framework natively.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .locomotion import RigidBodyLocomotionEnv
+from .rigidbody import SystemBuilder, capsule_inertia
+
+__all__ = ["HalfCheetah"]
+
+
+def _build_halfcheetah(act_mode: str = "position"):
+    b = SystemBuilder(
+        omega_pos=200.0,
+        omega_ang=200.0,
+        zeta=1.0,
+        limit_gain=4.0,
+        tone_ratio=0.1,
+        free_damping_ratio=0.1,
+        contact_k=15_000.0,
+        # near-critical contact damping (c_crit ~= 2*sqrt(k * m/leg) ~= 650):
+        # underdamped feet micro-bounce, and with a single foot sphere the
+        # bounce rectified through friction into a steady 1.5 m/s zero-action
+        # glide — free reward. Heel+toe feet symmetric about the ankle plus
+        # this damping bound the zero-action drift to a +/-0.1 m rock.
+        contact_c=600.0,
+        friction_mu=1.0,
+        tangent_damping=300.0,
+        act_mode=act_mode,
+        act_kp_ratio=2.0,
+    )
+
+    # Bodies (x forward, z up): a 1.0 m horizontal torso at hip height with a
+    # back leg hanging from its rear and a front leg from its nose, each
+    # thigh 0.29 / shin 0.26 / foot. Masses track the MuJoCo cheetah (~14 kg).
+    # The foot capsule length also sets the tangent-damping stability margin
+    # (c * r^2 / I * h < 2, rigidbody.py): 0.16 keeps it ~1.4.
+    z0 = 0.60
+    b.add_body("torso", (0, 0, z0), 6.4, capsule_inertia(6.4, 0.046, 1.0, "x"))
+    for part, px in (("back", -0.5), ("front", 0.5)):
+        b.add_body(f"{part}_thigh", (px, 0, z0 - 0.145), 1.5, capsule_inertia(1.5, 0.045, 0.29, "z"))
+        b.add_body(f"{part}_shin", (px, 0, z0 - 0.42), 1.2, capsule_inertia(1.2, 0.04, 0.26, "z"))
+        b.add_body(f"{part}_foot", (px, 0, z0 - 0.52), 0.9, capsule_inertia(0.9, 0.04, 0.16, "x"))
+
+    # Joints: 6 actuated DOF about y. Action layout:
+    #   0 back_hip, 1 back_knee, 2 back_ankle,
+    #   3 front_hip, 4 front_knee, 5 front_ankle
+    # Ranges loosely track the MuJoCo cheetah's asymmetric hips/knees.
+    for part, px, hip, knee, ankle in (
+        ("back", -0.5, (-0.6, 1.0), (-1.2, 0.8), (-0.5, 0.8)),
+        ("front", 0.5, (-1.0, 0.7), (-1.1, 0.8), (-0.5, 0.5)),
+    ):
+        b.add_joint(
+            "torso", f"{part}_thigh", (px, 0, z0),
+            free_axes=("y",), limits=[hip], gears=(90.0,),
+        )
+        b.add_joint(
+            f"{part}_thigh", f"{part}_shin", (px, 0, z0 - 0.29),
+            free_axes=("y",), limits=[knee], gears=(60.0,),
+        )
+        b.add_joint(
+            f"{part}_shin", f"{part}_foot", (px, 0, z0 - 0.55),
+            free_axes=("y",), limits=[ankle], gears=(30.0,),
+        )
+
+    # Colliders: heel + toe per foot first (observed contacts), then torso.
+    for part, px in (("back", -0.5), ("front", 0.5)):
+        b.add_sphere(f"{part}_foot", (px - 0.055, 0, z0 - 0.55), 0.046)  # heel
+        b.add_sphere(f"{part}_foot", (px + 0.055, 0, z0 - 0.55), 0.046)  # toe
+    b.add_sphere("torso", (-0.5, 0, z0), 0.046)
+    b.add_sphere("torso", (0.55, 0, z0 + 0.05), 0.046)  # head
+    return b.build()
+
+
+class HalfCheetah(RigidBodyLocomotionEnv):
+    """Planar cheetah; ``HalfCheetah-v4`` semantics: 6 actuated DOF, pure
+    ``forward_velocity - 0.1 * ||action||^2`` reward, no termination."""
+
+    planar = True
+    n_contact_obs = 4
+
+    def __init__(
+        self,
+        *,
+        forward_reward_weight: float = 1.0,
+        ctrl_cost_weight: float = 0.1,
+        reset_noise_scale: float = 0.005,
+        act_mode: str = "position",
+        dt: float = 0.015,
+        substeps: int = 8,
+    ):
+        self.sys, self._default_pos = _build_halfcheetah(act_mode)
+        self.dt = float(dt)
+        self.substeps = int(substeps)
+        self.forward_reward_weight = forward_reward_weight
+        self.alive_bonus = 0.0
+        self.ctrl_cost_weight = ctrl_cost_weight
+        self.reset_noise_scale = reset_noise_scale
+        self._finalize_spaces()
+
+    def _batch_reward_done(self, st, actions_minor, t):
+        # HalfCheetah never terminates: tumbling is allowed, only the time
+        # limit ends the episode (gymnasium HalfCheetah-v4 semantics)
+        forward_vel = st.vel[0, 0, :]
+        ctrl_cost = self.ctrl_cost_weight * jnp.sum(actions_minor * actions_minor, axis=0)
+        reward = self.forward_reward_weight * forward_vel - ctrl_cost
+        done = t >= self.max_episode_steps
+        return reward, done

@@ -59,6 +59,13 @@ class RigidBodyLocomotionEnv(Env):
     batched_native = True
     max_episode_steps = 1000
     n_contact_obs = 4
+    # planar tasks (Walker2D, HalfCheetah): constrain motion to the x-z
+    # sagittal plane, the engine's form of MuJoCo's 2-D worlds (those tasks
+    # simply omit the lateral DOF). Each control step projects the state back
+    # onto the plane: lateral velocity, roll and yaw rates are zeroed, body y
+    # snaps to the body plan's offsets, and orientations project onto pure
+    # y-rotations.
+    planar = False
     # largest per-substep h the default joint stiffness tolerates; the
     # semi-implicit Euler boundary is h * omega < 2 and the stiffest default
     # constraint frequency is omega ~= 250 rad/s, so 8ms keeps a safe margin
@@ -167,11 +174,24 @@ class RigidBodyLocomotionEnv(Env):
         state = EnvState(obs_state=st, t=jnp.zeros((B,), jnp.int32), key=split[:, 0])
         return state, self._batch_obs(st)
 
+    def _planar_project(self, st: BodyState) -> BodyState:
+        pos = st.pos.at[:, 1, :].set(self._default_pos[:, 1][:, None])
+        vel = st.vel.at[:, 1, :].set(0.0)
+        ang = st.ang.at[:, 0, :].set(0.0).at[:, 2, :].set(0.0)
+        w, y = st.quat[:, 0, :], st.quat[:, 2, :]
+        norm = jnp.sqrt(jnp.maximum(w * w + y * y, 1e-12))
+        quat = jnp.stack(
+            [w / norm, jnp.zeros_like(w), y / norm, jnp.zeros_like(w)], axis=1
+        )
+        return BodyState(pos=pos, quat=quat, vel=vel, ang=ang)
+
     def batch_step(self, state: EnvState, actions):
         """Step ``B`` lanes: ``actions`` ``(B, na)`` -> leading-batch outputs."""
         actions = jnp.clip(actions, self.action_space.lb, self.action_space.ub)
         a = actions.T  # (na, B): population-minor for the physics
         st = physics_step_batched(self.sys, state.obs_state, a, self.dt, self.substeps)
+        if self.planar:
+            st = self._planar_project(st)
         t = state.t + 1
         reward, done = self._batch_reward_done(st, a, t)
         return replace(state, obs_state=st, t=t), self._batch_obs(st), reward, done
@@ -196,6 +216,12 @@ class RigidBodyLocomotionEnv(Env):
         else:  # legacy raw uint32 keys, (B, 2)
             key = jnp.where(mask[:, None], ka, kb)
         return EnvState(obs_state=obs_state, t=t, key=key)
+
+    def batch_take(self, state: EnvState, idx) -> EnvState:
+        """Gather lanes ``idx`` (the rollout engine's lane compaction). The
+        body state is batch-trailing, ``t``/``key`` batch-leading."""
+        obs_state = jax.tree_util.tree_map(lambda x: x[..., idx], state.obs_state)
+        return EnvState(obs_state=obs_state, t=state.t[idx], key=state.key[idx])
 
     # -- single-instance API: the B=1 special case ---------------------------
     @staticmethod

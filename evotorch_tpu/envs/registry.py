@@ -63,5 +63,15 @@ def _register_defaults():
 
     register_env("ant", Ant)
 
+    from .walker2d import Walker2D
+
+    register_env("walker2d", Walker2D)
+    register_env("walker", Walker2D)
+
+    from .halfcheetah import HalfCheetah
+
+    register_env("halfcheetah", HalfCheetah)
+    register_env("half_cheetah", HalfCheetah)
+
 
 _register_defaults()

@@ -173,7 +173,22 @@ def run_host_vectorized_rollout(
     act_space = vec_env.action_space
     discrete = vec_env.is_discrete
 
+    # hard iteration cap (ADVICE r2): with episode_length=None and an env
+    # lacking its own TimeLimit the loop would otherwise never terminate;
+    # 100k steps/episode is far beyond any gym episode horizon
+    per_episode_cap = int(episode_length) if episode_length is not None else 100_000
+    step_cap = per_episode_cap * int(num_episodes)
+    total_loop_steps = 0
+
     while active.any():
+        if total_loop_steps >= step_cap:
+            raise RuntimeError(
+                f"run_host_vectorized_rollout exceeded {step_cap} lockstep"
+                " iterations without every lane finishing its episodes; the"
+                " env likely never terminates — pass episode_length= or wrap"
+                " it in a TimeLimit"
+            )
+        total_loop_steps += 1
         norm_obs = obs
         if obs_stats is not None and obs_stats.count >= 2:
             norm_obs = obs_stats.normalize(obs).astype(np.float32)

@@ -9,10 +9,19 @@ becomes here ONE jitted program: ``run_vectorized_rollout`` compiles the
 entire population x envs x time loop — masked activity, auto-reset,
 episode/interaction accounting, obs-norm statistics in the carry — into a
 single ``lax.while_loop`` (SURVEY.md §3.4 and §5 long-context note).
+
+``run_vectorized_rollout_compacting`` is the TPU answer to the idle-lane
+problem of the reference's evaluation contract (each lane runs its episodes
+then idles until the whole population finishes): the loop runs in chunks,
+and between chunks the still-active lanes are sorted to the front and the
+working width shrinks to the smallest allowed power-of-two that holds them —
+so once most of the population has finished, the machine stops paying for
+the dead lanes.
 """
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Any, NamedTuple, Optional
 
@@ -23,7 +32,13 @@ from ..net.functional import FlatParamsPolicy
 from ..net.rl import alive_bonus_for_step
 from ..net.runningnorm import CollectedStats, stats_normalize, stats_update
 
-__all__ = ["Policy", "reset_tensors", "run_vectorized_rollout", "RolloutResult"]
+__all__ = [
+    "Policy",
+    "reset_tensors",
+    "run_vectorized_rollout",
+    "run_vectorized_rollout_compacting",
+    "RolloutResult",
+]
 
 
 def reset_tensors(tree: Any, mask: jnp.ndarray) -> Any:
@@ -124,6 +139,24 @@ class RolloutResult(NamedTuple):
     total_episodes: jnp.ndarray  # scalar: episodes finished
 
 
+class RolloutCarry(NamedTuple):
+    """Loop state of the rollout engine. Per-lane leaves are batch-leading
+    except ``env_states`` (whose layout belongs to the env; see
+    ``Env.batched_native``); ``stats``/``key``/counters are global."""
+
+    env_states: Any
+    obs: jnp.ndarray
+    policy_states: Any
+    scores: jnp.ndarray
+    episodes_done: jnp.ndarray
+    steps_in_episode: jnp.ndarray
+    active: jnp.ndarray
+    stats: CollectedStats
+    key: Any
+    total_steps: jnp.ndarray
+    t_global: jnp.ndarray
+
+
 def _policy_to_action(raw, action_space, noise, clip: bool):
     if action_space.is_discrete:
         return jnp.argmax(raw, axis=-1)
@@ -131,6 +164,229 @@ def _policy_to_action(raw, action_space, noise, clip: bool):
     if clip and action_space.lb is not None:
         act = jnp.clip(act, action_space.lb, action_space.ub)
     return act
+
+
+def _env_reset(env, keys):
+    if getattr(env, "batched_native", False):
+        return env.batch_reset(keys)
+    return jax.vmap(env.reset)(keys)
+
+
+def _env_state_select(env, mask, a, b):
+    """Per-lane env-state select: lane i takes ``a`` where ``mask[i]``."""
+    if getattr(env, "batched_native", False):
+        return env.batch_where(mask, a, b)
+
+    def select(x, y):
+        m = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+        return jnp.where(m, x, y)
+
+    return jax.tree_util.tree_map(select, a, b)
+
+
+def _env_state_take(env, states, idx):
+    """Gather lanes ``idx`` out of a batched env state (lane compaction)."""
+    if getattr(env, "batched_native", False):
+        take = getattr(env, "batch_take", None)
+        if take is None:
+            raise NotImplementedError(
+                f"{type(env).__name__} is batched_native but does not implement"
+                " batch_take(states, idx); lane compaction needs it"
+            )
+        return take(states, idx)
+    return jax.tree_util.tree_map(lambda x: x[idx], states)
+
+
+def _rollout_init(
+    env,
+    policy: FlatParamsPolicy,
+    params_batch: jnp.ndarray,
+    key,
+    stats: CollectedStats,
+    *,
+    observation_normalization: bool,
+    compute_dtype,
+):
+    """Build the initial carry (full width) and the compute-dtype params."""
+    n = params_batch.shape[0]
+    if compute_dtype is not None:
+        params_batch = params_batch.astype(compute_dtype)
+
+    key, sub = jax.random.split(key)
+    reset_keys = jax.random.split(sub, n)
+    env_states, obs = _env_reset(env, reset_keys)
+    if observation_normalization:
+        # the initial reset observations are fed to the policy at t=0, so
+        # they belong in the normalization statistics (the reference updates
+        # stats on every observation the policy consumes)
+        stats = stats_update(stats, obs, mask=jnp.ones(n, dtype=bool))
+
+    policy_proto = policy.initial_state()
+    if policy_proto is None:
+        policy_states = None
+    else:
+        state_dtype = compute_dtype  # recurrent state lives in compute dtype
+        policy_states = jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(
+                leaf if state_dtype is None else leaf.astype(state_dtype),
+                (n,) + leaf.shape,
+            ),
+            policy_proto,
+        )
+
+    carry = RolloutCarry(
+        env_states=env_states,
+        obs=obs,
+        policy_states=policy_states,
+        scores=jnp.zeros(n),
+        episodes_done=jnp.zeros(n, dtype=jnp.int32),
+        steps_in_episode=jnp.zeros(n, dtype=jnp.int32),
+        active=jnp.ones(n, dtype=bool),
+        stats=stats,
+        key=key,
+        total_steps=jnp.zeros((), dtype=jnp.int32),
+        t_global=jnp.zeros((), dtype=jnp.int32),
+    )
+    return carry, params_batch
+
+
+@functools.lru_cache(maxsize=None)
+def _make_step(
+    env,
+    policy: FlatParamsPolicy,
+    *,
+    num_episodes: int,
+    max_t: int,
+    observation_normalization: bool,
+    alive_bonus_schedule,
+    decrease_rewards_by,
+    action_noise_stdev,
+    compute_dtype,
+    budget_mode: bool,
+):
+    """One masked control step of the whole population, as a pure function
+    ``step(params_batch, carry) -> carry``. Width is taken from the carry, so
+    the same step serves the monolithic loop and every compacted width.
+
+    When no lane can ever need a mid-rollout reset (episodes mode with
+    ``num_episodes == 1``), the per-step fresh ``env_reset`` — a per-lane key
+    split, reset noise and a full observation build — is skipped entirely and
+    finished lanes are *frozen* at their last pre-terminal state instead.
+    Frozen lanes keep stepping (masked) from a bounded, healthy state, so no
+    numerical blow-up can leak NaN into the masked statistics.
+    """
+    auto_reset = budget_mode or num_episodes > 1
+
+    def step(params_batch: jnp.ndarray, c: RolloutCarry) -> RolloutCarry:
+        n = c.active.shape[0]
+        key, noise_key, reset_key = jax.random.split(c.key, 3)
+
+        policy_in = (
+            stats_normalize(c.stats, c.obs) if observation_normalization else c.obs
+        )
+        if compute_dtype is not None:
+            policy_in = policy_in.astype(compute_dtype)
+        if c.policy_states is None:
+            raw, new_policy_states = jax.vmap(lambda p, o: policy(p, o))(
+                params_batch, policy_in
+            )
+        else:
+            raw, new_policy_states = jax.vmap(policy)(
+                params_batch, policy_in, c.policy_states
+            )
+        if compute_dtype is not None:
+            raw = raw.astype(jnp.float32)
+
+        noise = None
+        if action_noise_stdev is not None:
+            noise = action_noise_stdev * jax.random.normal(noise_key, raw.shape)
+        actions = _policy_to_action(raw, env.action_space, noise, clip=True)
+
+        if getattr(env, "batched_native", False):
+            new_env_states, new_obs, rewards, dones = env.batch_step(
+                c.env_states, actions
+            )
+        else:
+            new_env_states, new_obs, rewards, dones = jax.vmap(env.step)(
+                c.env_states, actions
+            )
+
+        steps_in_episode = c.steps_in_episode + 1
+        # guaranteed truncation at max_t (gym TimeLimit semantics): even an
+        # env that never emits done internally ends its episode here, so
+        # per-episode score averaging stays well-defined
+        dones = dones | (steps_in_episode >= max_t)
+
+        if decrease_rewards_by is not None:
+            rewards = rewards - decrease_rewards_by
+        if alive_bonus_schedule is not None:
+            rewards = rewards + alive_bonus_for_step(
+                steps_in_episode, alive_bonus_schedule
+            ) * (~dones)
+
+        active_f = c.active
+        scores = c.scores + jnp.where(active_f, rewards, 0.0)
+
+        finished = dones & active_f
+        episodes_done = c.episodes_done + finished.astype(jnp.int32)
+
+        def select(mask, new, old):
+            m = mask.reshape(mask.shape + (1,) * (new.ndim - 1))
+            return jnp.where(m, new, old)
+
+        if auto_reset:
+            # auto-reset the envs that finished an episode
+            reset_keys = jax.random.split(reset_key, n)
+            fresh_states, fresh_obs = _env_reset(env, reset_keys)
+            env_states_next = _env_state_select(
+                env, finished, fresh_states, new_env_states
+            )
+            obs_next = select(finished, fresh_obs, new_obs)
+            steps_in_episode = jnp.where(finished, 0, steps_in_episode)
+            if new_policy_states is not None:
+                new_policy_states = reset_tensors(new_policy_states, finished)
+            if budget_mode:
+                active = active_f  # every lane runs its full budget
+            else:
+                active = episodes_done < num_episodes
+        else:
+            # freeze finished lanes at their last pre-terminal state: they
+            # never run another episode, so no fresh reset is ever needed
+            active = episodes_done < num_episodes
+            env_states_next = _env_state_select(
+                env, active, new_env_states, c.env_states
+            )
+            obs_next = select(active, new_obs, c.obs)
+            steps_in_episode = jnp.where(active, steps_in_episode, 0)
+
+        if budget_mode:
+            total_steps = c.total_steps + n
+        else:
+            total_steps = c.total_steps + jnp.sum(active_f.astype(jnp.int32))
+        # normalization statistics come from the observations the policy will
+        # actually consume next step: post-reset-selection obs, masked by the
+        # envs still running (ADVICE r1: not the pre-reset terminal obs)
+        new_stats = (
+            stats_update(c.stats, obs_next, mask=active)
+            if observation_normalization
+            else c.stats
+        )
+
+        return RolloutCarry(
+            env_states=env_states_next,
+            obs=obs_next,
+            policy_states=new_policy_states,
+            scores=scores,
+            episodes_done=episodes_done,
+            steps_in_episode=steps_in_episode,
+            active=active,
+            stats=new_stats,
+            key=key,
+            total_steps=total_steps,
+            t_global=c.t_global + 1,
+        )
+
+    return step
 
 
 @partial(
@@ -183,7 +439,9 @@ def run_vectorized_rollout(
       exactly ``num_episodes`` episodes, then idles (masked) until every lane
       is finished. The ``lax.while_loop`` exits as soon as all lanes are done,
       but in the worst case the whole population waits on its longest
-      survivor — finished lanes burn compute producing nothing.
+      survivor — finished lanes burn compute producing nothing. For the
+      host-orchestrated variant that reclaims that compute, see
+      ``run_vectorized_rollout_compacting``.
     - ``"budget"``: each lane consumes a fixed interaction budget of
       ``num_episodes * max_episode_steps`` steps, auto-resetting whenever an
       episode ends; the score is the average episodic return over the budget
@@ -196,179 +454,37 @@ def run_vectorized_rollout(
     """
     if eval_mode not in ("episodes", "budget"):
         raise ValueError(f"eval_mode must be 'episodes' or 'budget', got {eval_mode!r}")
-    n = params_batch.shape[0]
-    if compute_dtype is not None:
-        params_batch = params_batch.astype(compute_dtype)
     max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
     if episode_length is not None:
         max_t = min(max_t, int(episode_length))
     hard_cap = max_t * int(num_episodes) + 1
-
-    # natively-batched envs (population-minor internal layout; see
-    # envs/base.py) expose batch_reset/batch_step/batch_where, which the
-    # engine prefers over vmap — on TPU this is the difference between 3%
-    # and full lane utilization in the loop-carried physics state
-    batched_env = getattr(env, "batched_native", False)
-
-    def env_reset(keys):
-        if batched_env:
-            return env.batch_reset(keys)
-        return jax.vmap(env.reset)(keys)
-
-    key, sub = jax.random.split(key)
-    reset_keys = jax.random.split(sub, n)
-    env_states, obs = env_reset(reset_keys)
-    if observation_normalization:
-        # the initial reset observations are fed to the policy at t=0, so
-        # they belong in the normalization statistics (the reference updates
-        # stats on every observation the policy consumes)
-        stats = stats_update(stats, obs, mask=jnp.ones(n, dtype=bool))
-
-    policy_proto = policy.initial_state()
-    if policy_proto is None:
-        policy_states = None
-    else:
-        state_dtype = compute_dtype  # recurrent state lives in compute dtype
-        policy_states = jax.tree_util.tree_map(
-            lambda leaf: jnp.broadcast_to(
-                leaf if state_dtype is None else leaf.astype(state_dtype),
-                (n,) + leaf.shape,
-            ),
-            policy_proto,
-        )
-
-    class Carry(NamedTuple):
-        env_states: Any
-        obs: jnp.ndarray
-        policy_states: Any
-        scores: jnp.ndarray
-        episodes_done: jnp.ndarray
-        steps_in_episode: jnp.ndarray
-        active: jnp.ndarray
-        stats: CollectedStats
-        key: Any
-        total_steps: jnp.ndarray
-        t_global: jnp.ndarray
-
-    carry = Carry(
-        env_states=env_states,
-        obs=obs,
-        policy_states=policy_states,
-        scores=jnp.zeros(n),
-        episodes_done=jnp.zeros(n, dtype=jnp.int32),
-        steps_in_episode=jnp.zeros(n, dtype=jnp.int32),
-        active=jnp.ones(n, dtype=bool),
-        stats=stats,
-        key=key,
-        total_steps=jnp.zeros((), dtype=jnp.int32),
-        t_global=jnp.zeros((), dtype=jnp.int32),
-    )
-
     budget_mode = eval_mode == "budget"
 
-    def cond(c: Carry):
-        return jnp.any(c.active) & (c.t_global < hard_cap)
-
-    def body(c: Carry) -> Carry:
-        key, noise_key, reset_key = jax.random.split(c.key, 3)
-
-        policy_in = (
-            stats_normalize(c.stats, c.obs) if observation_normalization else c.obs
-        )
-        if compute_dtype is not None:
-            policy_in = policy_in.astype(compute_dtype)
-        if c.policy_states is None:
-            raw, new_policy_states = jax.vmap(lambda p, o: policy(p, o))(
-                params_batch, policy_in
-            )
-        else:
-            raw, new_policy_states = jax.vmap(policy)(params_batch, policy_in, c.policy_states)
-        if compute_dtype is not None:
-            raw = raw.astype(jnp.float32)
-
-        noise = None
-        if action_noise_stdev is not None:
-            noise = action_noise_stdev * jax.random.normal(noise_key, raw.shape)
-        actions = _policy_to_action(raw, env.action_space, noise, clip=True)
-
-        if batched_env:
-            new_env_states, new_obs, rewards, dones = env.batch_step(
-                c.env_states, actions
-            )
-        else:
-            new_env_states, new_obs, rewards, dones = jax.vmap(env.step)(
-                c.env_states, actions
-            )
-
-        steps_in_episode = c.steps_in_episode + 1
-        # guaranteed truncation at max_t (gym TimeLimit semantics): even an
-        # env that never emits done internally ends its episode here, so
-        # per-episode score averaging stays well-defined
-        dones = dones | (steps_in_episode >= max_t)
-
-        if decrease_rewards_by is not None:
-            rewards = rewards - decrease_rewards_by
-        if alive_bonus_schedule is not None:
-            rewards = rewards + alive_bonus_for_step(
-                steps_in_episode, alive_bonus_schedule
-            ) * (~dones)
-
-        active_f = c.active
-        scores = c.scores + jnp.where(active_f, rewards, 0.0)
-
-        # auto-reset the envs that finished an episode (only matters while active)
-        finished = dones & active_f
-        episodes_done = c.episodes_done + finished.astype(jnp.int32)
-        reset_keys = jax.random.split(reset_key, n)
-        fresh_states, fresh_obs = env_reset(reset_keys)
-
-        def select(new, fresh):
-            m = finished.reshape(finished.shape + (1,) * (new.ndim - 1))
-            return jnp.where(m, fresh, new)
-
-        if batched_env:
-            env_states_next = env.batch_where(finished, fresh_states, new_env_states)
-        else:
-            env_states_next = jax.tree_util.tree_map(
-                select, new_env_states, fresh_states
-            )
-        obs_next = select(new_obs, fresh_obs)
-        steps_in_episode = jnp.where(finished, 0, steps_in_episode)
-        if new_policy_states is not None:
-            new_policy_states = reset_tensors(new_policy_states, finished)
-
-        if budget_mode:
-            active = active_f  # every lane runs its full budget
-            total_steps = c.total_steps + n
-        else:
-            active = episodes_done < num_episodes
-            total_steps = c.total_steps + jnp.sum(active_f.astype(jnp.int32))
-        # normalization statistics come from the observations the policy will
-        # actually consume next step: post-reset-selection obs, masked by the
-        # envs still running (ADVICE r1: not the pre-reset terminal obs)
-        new_stats = (
-            stats_update(c.stats, obs_next, mask=active)
-            if observation_normalization
-            else c.stats
-        )
-
-        return Carry(
-            env_states=env_states_next,
-            obs=obs_next,
-            policy_states=new_policy_states,
-            scores=scores,
-            episodes_done=episodes_done,
-            steps_in_episode=steps_in_episode,
-            active=active,
-            stats=new_stats,
-            key=key,
-            total_steps=total_steps,
-            t_global=c.t_global + 1,
-        )
+    carry, params_batch = _rollout_init(
+        env,
+        policy,
+        params_batch,
+        key,
+        stats,
+        observation_normalization=observation_normalization,
+        compute_dtype=compute_dtype,
+    )
+    step = _make_step(
+        env,
+        policy,
+        num_episodes=int(num_episodes),
+        max_t=max_t,
+        observation_normalization=observation_normalization,
+        alive_bonus_schedule=alive_bonus_schedule,
+        decrease_rewards_by=decrease_rewards_by,
+        action_noise_stdev=action_noise_stdev,
+        compute_dtype=compute_dtype,
+        budget_mode=budget_mode,
+    )
 
     if budget_mode:
         budget = max_t * int(num_episodes)
-        final = jax.lax.fori_loop(0, budget, lambda _, c: body(c), carry)
+        final = jax.lax.fori_loop(0, budget, lambda _, c: step(params_batch, c), carry)
         # average episodic return over the budget: completed episodes plus
         # the fractional trailing one (exactly the episodic mean whenever the
         # budget lands on an episode boundary)
@@ -377,11 +493,251 @@ def run_vectorized_rollout(
         )
         mean_scores = final.scores / jnp.maximum(episodes_frac, 1.0 / max_t)
     else:
-        final = jax.lax.while_loop(cond, body, carry)
+
+        def cond(c: RolloutCarry):
+            return jnp.any(c.active) & (c.t_global < hard_cap)
+
+        final = jax.lax.while_loop(cond, lambda c: step(params_batch, c), carry)
         mean_scores = final.scores / jnp.maximum(final.episodes_done, 1)
     return RolloutResult(
         scores=mean_scores,
         stats=final.stats,
         total_steps=final.total_steps,
         total_episodes=jnp.sum(final.episodes_done),
+    )
+
+
+# --------------------------- lane-compacting runner ---------------------------
+
+
+def _pow2_at_least(x: int) -> int:
+    p = 1
+    while p < x:
+        p *= 2
+    return p
+
+
+@functools.lru_cache(maxsize=None)
+def _compacting_fns(
+    env,
+    policy: FlatParamsPolicy,
+    num_episodes: int,
+    max_t: int,
+    hard_cap: int,
+    observation_normalization: bool,
+    alive_bonus_schedule,
+    decrease_rewards_by,
+    action_noise_stdev,
+    compute_dtype,
+):
+    """Jitted building blocks of the compacting runner, cached per config so
+    repeated calls (every generation) hit XLA's compile cache."""
+    step = _make_step(
+        env,
+        policy,
+        num_episodes=num_episodes,
+        max_t=max_t,
+        observation_normalization=observation_normalization,
+        alive_bonus_schedule=alive_bonus_schedule,
+        decrease_rewards_by=decrease_rewards_by,
+        action_noise_stdev=action_noise_stdev,
+        compute_dtype=compute_dtype,
+        budget_mode=False,
+    )
+
+    @jax.jit
+    def init_fn(params_batch, key, stats):
+        return _rollout_init(
+            env,
+            policy,
+            params_batch,
+            key,
+            stats,
+            observation_normalization=observation_normalization,
+            compute_dtype=compute_dtype,
+        )
+
+    @partial(jax.jit, static_argnames=("num_steps",))
+    def chunk_fn(params_batch, carry, num_steps: int):
+        def cond(s):
+            i, c = s
+            return (i < num_steps) & jnp.any(c.active) & (c.t_global < hard_cap)
+
+        def body(s):
+            i, c = s
+            return i + 1, step(params_batch, c)
+
+        _, out = jax.lax.while_loop(cond, body, (jnp.zeros((), jnp.int32), carry))
+        return out, jnp.sum(out.active.astype(jnp.int32))
+
+    @partial(jax.jit, static_argnames=("new_width",))
+    def compact_fn(carry, params_batch, lane_ids, scores_buf, eps_buf, new_width: int):
+        # flush every current lane's (final-so-far) score to the full-width
+        # buffers, then gather the still-active lanes to the front
+        scores_buf = scores_buf.at[lane_ids].set(carry.scores)
+        eps_buf = eps_buf.at[lane_ids].set(carry.episodes_done)
+        order = jnp.argsort(jnp.logical_not(carry.active))  # stable: active first
+        sel = order[:new_width]
+        new_carry = RolloutCarry(
+            env_states=_env_state_take(env, carry.env_states, sel),
+            obs=carry.obs[sel],
+            policy_states=(
+                None
+                if carry.policy_states is None
+                else jax.tree_util.tree_map(lambda x: x[sel], carry.policy_states)
+            ),
+            scores=carry.scores[sel],
+            episodes_done=carry.episodes_done[sel],
+            steps_in_episode=carry.steps_in_episode[sel],
+            active=carry.active[sel],
+            stats=carry.stats,
+            key=carry.key,
+            total_steps=carry.total_steps,
+            t_global=carry.t_global,
+        )
+        return new_carry, params_batch[sel], lane_ids[sel], scores_buf, eps_buf
+
+    @jax.jit
+    def finalize_fn(carry, lane_ids, scores_buf, eps_buf):
+        scores_buf = scores_buf.at[lane_ids].set(carry.scores)
+        eps_buf = eps_buf.at[lane_ids].set(carry.episodes_done)
+        mean_scores = scores_buf / jnp.maximum(eps_buf, 1)
+        return mean_scores, jnp.sum(eps_buf)
+
+    return init_fn, chunk_fn, compact_fn, finalize_fn
+
+
+def run_vectorized_rollout_compacting(
+    env,
+    policy: FlatParamsPolicy,
+    params_batch: jnp.ndarray,
+    key,
+    stats: CollectedStats,
+    *,
+    num_episodes: int = 1,
+    episode_length: Optional[int] = None,
+    observation_normalization: bool = False,
+    alive_bonus_schedule: Optional[tuple] = None,
+    decrease_rewards_by: Optional[float] = None,
+    action_noise_stdev: Optional[float] = None,
+    compute_dtype=None,
+    chunk_size: int = 25,
+    min_width: Optional[int] = None,
+    allowed_widths: Optional[tuple] = None,
+    prewarm: bool = False,
+) -> RolloutResult:
+    """Episodes-contract evaluation with **lane compaction** — the
+    host-orchestrated fast path for ``eval_mode="episodes"``.
+
+    Semantics are those of ``run_vectorized_rollout(eval_mode="episodes")``
+    (the reference's ``VecGymNE`` contract, ``vecgymne.py:837-904``): each
+    lane runs exactly ``num_episodes`` episodes and its score is the mean
+    episodic return. The difference is purely how the machine spends its
+    cycles: the loop runs in ``chunk_size``-step jitted chunks; after each
+    chunk the number of still-active lanes is inspected, and when it fits in
+    a smaller allowed width the active lanes are sorted to the front,
+    gathered, and the loop continues narrow — finished lanes stop consuming
+    compute instead of idling masked until the slowest survivor ends.
+
+    Orchestration details:
+
+    - The compaction decision is **pipelined one chunk behind**: the next
+      chunk is dispatched before the previous chunk's active-count is read,
+      so the device never sits idle waiting on the host round-trip (which
+      matters on tunneled TPU links).
+    - Widths come from a small fixed menu (``allowed_widths``, default
+      ``{N} ∪ {powers of two in [min_width, N/2]}`` with at most 4 entries),
+      and the width descends at most one menu step per chunk — so the set of
+      XLA compilations is exactly the chain of adjacent width pairs, which
+      ``prewarm=True`` compiles up front (so a later, deeper compaction never
+      drops a compile into someone's timing loop).
+    - Results are scattered into full-width device buffers keyed by original
+      lane id, so scores come back in the caller's order with no host-side
+      bookkeeping.
+
+    With ``num_episodes == 1`` (the benchmark configuration) the scores are
+    numerically identical to the monolithic runner's: compaction reorders
+    lanes but every lane's dynamics, policy and reward stream are per-lane
+    deterministic. (With ``num_episodes > 1`` or ``action_noise_stdev`` the
+    per-step RNG fan-out depends on the working width, so individual scores
+    differ in distribution-equivalent ways.)
+
+    Not traceable (it syncs lane counts to the host); use the monolithic
+    runner inside jit/shard_map.
+    """
+    n = params_batch.shape[0]
+    max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
+    if episode_length is not None:
+        max_t = min(max_t, int(episode_length))
+    hard_cap = max_t * int(num_episodes) + 1
+
+    init_fn, chunk_fn, compact_fn, finalize_fn = _compacting_fns(
+        env,
+        policy,
+        int(num_episodes),
+        max_t,
+        hard_cap,
+        bool(observation_normalization),
+        alive_bonus_schedule,
+        decrease_rewards_by,
+        action_noise_stdev,
+        compute_dtype,
+    )
+
+    if allowed_widths is None:
+        if min_width is None:
+            min_width = max(256, _pow2_at_least(max(1, n // 16)))
+        widths = []
+        w = _pow2_at_least(min_width)
+        while w <= n // 2:
+            widths.append(w)
+            w *= 2
+        allowed_widths = tuple(sorted(widths))
+    else:
+        allowed_widths = tuple(sorted(int(w) for w in allowed_widths if w < n))
+
+    carry, params = init_fn(params_batch, key, stats)
+    lane_ids = jnp.arange(n, dtype=jnp.int32)
+    scores_buf = jnp.zeros(n, dtype=jnp.float32)
+    eps_buf = jnp.zeros(n, dtype=jnp.int32)
+
+    if prewarm:
+        # compile the whole descent chain (chunk + finalize at every width,
+        # every adjacent compact pair) on throwaway copies of the initial state
+        c, p, ids, sb, eb = carry, params, lane_ids, scores_buf, eps_buf
+        c, _ = chunk_fn(p, c, int(chunk_size))
+        finalize_fn(c, ids, sb, eb)
+        for w in sorted(allowed_widths, reverse=True):
+            c, p, ids, sb, eb = compact_fn(c, p, ids, sb, eb, w)
+            c, _ = chunk_fn(p, c, int(chunk_size))
+            finalize_fn(c, ids, sb, eb)
+        jax.block_until_ready(c.scores)
+
+    max_chunks = -(-hard_cap // int(chunk_size)) + 1
+    prev_count = None
+    for _ in range(max_chunks):
+        carry, count = chunk_fn(params, carry, int(chunk_size))
+        if prev_count is not None:
+            # reading the PREVIOUS chunk's count: that result is already (or
+            # nearly) computed, while the chunk just dispatched keeps the
+            # device busy during this host round-trip
+            n_active = int(prev_count)
+            if n_active == 0:
+                break
+            width = carry.active.shape[0]
+            # descend at most one menu step per chunk: compilation work is
+            # bounded to the chain of adjacent width pairs
+            lower = [w for w in allowed_widths if w < width]
+            if lower and n_active <= max(lower):
+                carry, params, lane_ids, scores_buf, eps_buf = compact_fn(
+                    carry, params, lane_ids, scores_buf, eps_buf, max(lower)
+                )
+        prev_count = count
+
+    mean_scores, total_episodes = finalize_fn(carry, lane_ids, scores_buf, eps_buf)
+    return RolloutResult(
+        scores=mean_scores,
+        stats=carry.stats,
+        total_steps=carry.total_steps,
+        total_episodes=total_episodes,
     )

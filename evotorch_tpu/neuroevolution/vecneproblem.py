@@ -28,7 +28,7 @@ from .neproblem import NEProblem
 from .net.layers import Module
 from .net.rl import ActClipLayer
 from .net.runningnorm import RunningNorm
-from .net.vecrl import run_vectorized_rollout
+from .net.vecrl import run_vectorized_rollout, run_vectorized_rollout_compacting
 
 __all__ = ["VecNE", "VecGymNE"]
 
@@ -70,12 +70,16 @@ class VecNE(NEProblem):
         self._num_episodes = int(num_episodes)
         self._episode_length = None if episode_length is None else int(episode_length)
         # "episodes" = reference VecGymNE semantics (each lane runs
-        # num_episodes episodes then idles); "budget" = fixed interaction
-        # budget with auto-reset — the throughput-optimal contract where every
-        # computed step is a counted interaction (net/vecrl.py docstring)
-        if eval_mode not in ("episodes", "budget"):
+        # num_episodes episodes then idles); "episodes_compact" = the same
+        # contract evaluated by the lane-compacting runner (finished lanes are
+        # repacked out of the working set between chunks — see
+        # net/vecrl.py:run_vectorized_rollout_compacting); "budget" = fixed
+        # interaction budget with auto-reset — the throughput-optimal contract
+        # where every computed step is a counted interaction
+        if eval_mode not in ("episodes", "episodes_compact", "budget"):
             raise ValueError(
-                f"eval_mode must be 'episodes' or 'budget', got {eval_mode!r}"
+                "eval_mode must be 'episodes', 'episodes_compact' or 'budget',"
+                f" got {eval_mode!r}"
             )
         self._eval_mode = str(eval_mode)
         self._max_num_envs = None if max_num_envs is None else int(max_num_envs)
@@ -136,12 +140,7 @@ class VecNE(NEProblem):
 
     # ------------------------------------------------------------ evaluation
     def _rollout_batch(self, values: jnp.ndarray, key) -> tuple:
-        result = run_vectorized_rollout(
-            self._env,
-            self._policy,
-            values,
-            key,
-            self._obs_norm.stats,
+        kwargs = dict(
             num_episodes=self._num_episodes,
             episode_length=self._episode_length,
             observation_normalization=self._observation_normalization,
@@ -149,9 +148,20 @@ class VecNE(NEProblem):
             decrease_rewards_by=self._decrease_rewards_by,
             action_noise_stdev=self._action_noise_stdev,
             compute_dtype=self._compute_dtype,
-            eval_mode=self._eval_mode,
         )
-        return result
+        if self._eval_mode == "episodes_compact":
+            return run_vectorized_rollout_compacting(
+                self._env, self._policy, values, key, self._obs_norm.stats, **kwargs
+            )
+        return run_vectorized_rollout(
+            self._env,
+            self._policy,
+            values,
+            key,
+            self._obs_norm.stats,
+            eval_mode=self._eval_mode,
+            **kwargs,
+        )
 
     def _resolve_num_actors_request(self):
         """VecNE honors ``num_actors`` through its own sharded path (the
@@ -277,6 +287,9 @@ class VecNE(NEProblem):
 
         stats = self._obs_norm.stats
         obsnorm = self._observation_normalization
+        # the compacting runner is host-orchestrated and cannot run inside
+        # shard_map; the sharded path evaluates the same contract monolithically
+        eval_mode = "episodes" if self._eval_mode == "episodes_compact" else self._eval_mode
 
         def local(values_shard, key, stats):
             my_key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
@@ -293,7 +306,7 @@ class VecNE(NEProblem):
                 decrease_rewards_by=self._decrease_rewards_by,
                 action_noise_stdev=self._action_noise_stdev,
                 compute_dtype=self._compute_dtype,
-                eval_mode=self._eval_mode,
+                eval_mode=eval_mode,
             )
             # merge the per-shard stat deltas with a psum
             delta = jax.tree_util.tree_map(lambda new, old: new - old, result.stats, stats)

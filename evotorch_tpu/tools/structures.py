@@ -202,6 +202,11 @@ class CMemory:
         return self.get(key)
 
     def _apply(self, key, op, value, where) -> "CMemory":
+        # Gather-style calls (extra leading key dims) with DUPLICATE keys
+        # apply last-write-wins — each slot takes one read-modify-write, so
+        # e.g. add_ with a key appearing twice adds once, matching torch's
+        # non-accumulating index_put_ (the reference's write primitive). Use
+        # one call per increment (or pre-reduce host-side) to accumulate.
         parts, valid = self._normalize_keys(key)
         idx = self._address(parts)
         current = self.data[idx]
@@ -263,21 +268,40 @@ class CDict:
 
     @staticmethod
     def create(
-        keys_or_num_keys,
+        keys_or_num_keys=None,
         *value_shape: int,
         dtype=jnp.float32,
         fill: float = 0.0,
         batch_shape: tuple = (),
         key_offset=None,
+        names=None,
+        num_keys=None,
     ) -> "CDict":
-        names = None
-        num_keys = keys_or_num_keys
-        if not isinstance(keys_or_num_keys, int) and not (
-            isinstance(keys_or_num_keys, (tuple, list))
-            and all(isinstance(k, int) for k in keys_or_num_keys)
-        ):
-            names = tuple(keys_or_num_keys)
-            num_keys = len(names)
+        """Positional dispatch: an int (or tuple of ints) is a key-space
+        shape; any other iterable is a name list. A sequence of *integer
+        names* is indistinguishable positionally — pass the explicit
+        ``names=[...]`` / ``num_keys=...`` keywords to disambiguate."""
+        if names is not None or num_keys is not None:
+            if keys_or_num_keys is not None:
+                raise TypeError(
+                    "Pass either the positional keys_or_num_keys or the"
+                    " explicit names=/num_keys= keywords, not both"
+                )
+            if names is not None and num_keys is not None:
+                raise TypeError("names= and num_keys= are mutually exclusive")
+            if names is not None:
+                names = tuple(names)
+                num_keys = len(names)
+        elif keys_or_num_keys is None:
+            raise TypeError("CDict.create needs keys_or_num_keys, names= or num_keys=")
+        else:
+            num_keys = keys_or_num_keys
+            if not isinstance(keys_or_num_keys, int) and not (
+                isinstance(keys_or_num_keys, (tuple, list))
+                and all(isinstance(k, int) for k in keys_or_num_keys)
+            ):
+                names = tuple(keys_or_num_keys)
+                num_keys = len(names)
         memory = CMemory.create(
             num_keys,
             *value_shape,
@@ -638,12 +662,20 @@ class CBag:
 
     # ------------------------------------------------------------ operations
     def push_(self, key, where=None) -> "CBag":
-        key = jnp.broadcast_to(jnp.asarray(key), self.batch_shape)
+        """Push key(s). Like the CMemory/CList gathers, ``key`` may carry
+        extra leading dims beyond ``batch_shape`` to push several elements in
+        one call (duplicates accumulate — pushes are scatter-adds). With a
+        ``capacity``, admission is checked against the *pre-call* total, so a
+        single multi-key push may overshoot the capacity by up to the number
+        of keys pushed together."""
+        key = jnp.asarray(key)
+        common = jnp.broadcast_shapes(self.batch_shape, key.shape)
+        key = jnp.broadcast_to(key, common)
         ok = (key >= 0) & (key < self.num_keys)
         if self.capacity is not None:
             ok = ok & (self.total < self.capacity)
         if where is not None:
-            ok = ok & jnp.broadcast_to(jnp.asarray(where), self.batch_shape)
+            ok = ok & jnp.broadcast_to(jnp.asarray(where), common)
         idx = _open_grid(self.batch_shape) + (jnp.clip(key, 0, self.num_keys - 1),)
         return replace(self, counts=self.counts.at[idx].add(ok.astype(jnp.int32)))
 
@@ -654,13 +686,19 @@ class CBag:
         return replace(self, counts=jnp.where(where[..., None], 0, self.counts))
 
     def _pop_specific(self, key, where) -> tuple:
-        key = jnp.broadcast_to(jnp.asarray(key), self.batch_shape)
+        # like push_, extra leading key dims pop several elements in one call;
+        # presence (ok) is checked against the pre-call counts, so popping the
+        # same key more times than its count in one call over-reports ok —
+        # the clamp below keeps the counts themselves valid (>= 0) regardless
+        key = jnp.asarray(key)
+        common = jnp.broadcast_shapes(self.batch_shape, key.shape)
+        key = jnp.broadcast_to(key, common)
         idx = _open_grid(self.batch_shape) + (jnp.clip(key, 0, self.num_keys - 1),)
         ok = (key >= 0) & (key < self.num_keys) & (self.counts[idx] > 0)
         if where is not None:
-            ok = ok & jnp.broadcast_to(jnp.asarray(where), self.batch_shape)
-        new = replace(self, counts=self.counts.at[idx].add(-ok.astype(jnp.int32)))
-        return new, key, ok
+            ok = ok & jnp.broadcast_to(jnp.asarray(where), common)
+        counts = jnp.maximum(self.counts.at[idx].add(-ok.astype(jnp.int32)), 0)
+        return replace(self, counts=counts), key, ok
 
     def _pop_random(self, rng, where) -> tuple:
         def draw(key, counts):

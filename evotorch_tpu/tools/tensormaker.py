@@ -84,6 +84,37 @@ class TensorMakerMixin:
                 raise ValueError("make_I needs a size when the owner has no solution_length")
         return jnp.eye(int(size), dtype=self._make_dtype(dtype, use_eval_dtype))
 
+    def make_tensor(self, data, *, dtype=None, use_eval_dtype=False, read_only: bool = False):
+        """Convert ``data`` to an array in the owner's dtype — or to an
+        :class:`ObjectArray` when ``dtype=object`` (reference
+        ``tensormaker.py:142`` -> ``misc.py:1138``). JAX arrays are immutable,
+        so ``read_only`` is accepted for API familiarity and is a no-op for
+        the numeric case."""
+        if dtype is not None and to_jax_dtype(dtype) is object:
+            from .objectarray import ObjectArray
+
+            out = ObjectArray.from_values(data)
+            return out.get_read_only_view() if read_only else out
+        return jnp.asarray(data, dtype=self._make_dtype(dtype, use_eval_dtype))
+
+    def make_uniform_shaped_like(self, t, *, lb=None, ub=None, key=None):
+        """Uniform random array with ``t``'s shape and dtype (reference
+        ``tensormaker.py:866``)."""
+        t = jnp.asarray(t)
+        # 0-d inputs must yield 0-d outputs (an empty *shape would fall back
+        # to the owner's solution_length default)
+        shape = t.shape if t.ndim else (1,)
+        out = self.make_uniform(*shape, lb=lb, ub=ub, dtype=t.dtype, key=key)
+        return out.reshape(t.shape)
+
+    def make_gaussian_shaped_like(self, t, *, center=None, stdev=None, key=None):
+        """Gaussian random array with ``t``'s shape and dtype (reference
+        ``tensormaker.py:893``)."""
+        t = jnp.asarray(t)
+        shape = t.shape if t.ndim else (1,)
+        out = self.make_gaussian(*shape, center=center, stdev=stdev, dtype=t.dtype, key=key)
+        return out.reshape(t.shape)
+
     # -- random fills --------------------------------------------------------
     def make_uniform(self, *size: Size, num_solutions=None, lb=None, ub=None, dtype=None, use_eval_dtype=False, key=None):
         dtype = self._make_dtype(dtype, use_eval_dtype)

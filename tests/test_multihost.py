@@ -17,6 +17,8 @@ import textwrap
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 _WORKER = textwrap.dedent(
     """
     import os, sys

@@ -404,3 +404,41 @@ def test_gymne_vectorized_matches_serial_regime():
     # same regime for the same policies
     assert (v >= 1.0).all() and (v <= 40.0).all()
     assert (s >= 1.0).all() and (s <= 40.0).all()
+
+
+def test_vecne_episodes_compact_eval_mode():
+    # the lane-compacting evaluator behind the OO problem (verify r3: the
+    # dispatch path itself must be exercised, not only the runner)
+    problem = VecNE(
+        "cartpole",
+        "Linear(obs_length, act_length)",
+        env_config={"continuous_actions": False},
+        eval_mode="episodes_compact",
+        observation_normalization=True,
+        episode_length=100,
+        seed=0,
+    )
+    batch = problem.generate_batch(16)
+    problem.evaluate(batch)
+    scores = np.asarray(batch.evals[:, 0])
+    assert np.isfinite(scores).all()
+    assert (scores >= 1.0).all() and (scores <= 100.0).all()
+    assert problem.status["total_episode_count"] == 16
+    assert problem.obs_norm.count > 0
+
+    # same contract as plain episodes mode: a fresh identical problem in
+    # monolithic episodes mode must agree on the scores
+    problem2 = VecNE(
+        "cartpole",
+        "Linear(obs_length, act_length)",
+        env_config={"continuous_actions": False},
+        eval_mode="episodes",
+        observation_normalization=True,
+        episode_length=100,
+        seed=0,
+    )
+    batch2 = problem2.generate_batch(16)
+    problem2.evaluate(batch2)
+    np.testing.assert_allclose(
+        np.asarray(batch2.evals[:, 0]), scores, rtol=1e-5, atol=1e-5
+    )

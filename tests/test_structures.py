@@ -258,3 +258,46 @@ def test_clist_unbatched_array_index_gather():
     lst = CList.create(4).append_(1.0).append_(2.0).append_(3.0)
     got = lst.get(jnp.asarray([0, 2, -1]))
     np.testing.assert_allclose(np.asarray(got), [1.0, 3.0, 3.0])
+
+
+def test_cbag_unbatched_multi_key_push_accumulates():
+    # ADVICE r2: pushing an array of keys on an unbatched bag must accumulate
+    # (scatter-add), including duplicates in the same call
+    bag = CBag.create(4)
+    bag = bag.push_(jnp.asarray([2, 2, 0]))
+    assert int(bag.counts[2]) == 2
+    assert int(bag.counts[0]) == 1
+    assert int(bag.total) == 3
+    # multi-key specific pop on the unbatched bag
+    bag, popped, ok = bag.pop_(jnp.asarray([2, 0]))
+    assert bool(ok.all())
+    assert int(bag.total) == 1
+    assert int(bag.counts[2]) == 1
+
+
+def test_cdict_create_explicit_keywords():
+    import pytest
+
+    # integer names are only reachable through the explicit keyword
+    d = CDict.create(names=[10, 20])
+    d = d.set_(10, jnp.asarray(3.0))
+    assert float(d.get(10)) == 3.0
+    assert not bool(d.contains(20))
+    d2 = CDict.create(num_keys=4)
+    d2 = d2.set_(1, jnp.asarray(2.0))
+    assert float(d2.get(1)) == 2.0
+    with pytest.raises(TypeError):
+        CDict.create(4, num_keys=4)
+    with pytest.raises(TypeError):
+        CDict.create(names=["a"], num_keys=2)
+    with pytest.raises(TypeError):
+        CDict.create()
+
+
+def test_cbag_duplicate_pop_clamps_at_zero():
+    # code-review r3: duplicate keys in one multi-key pop must not drive
+    # counts negative (ok may over-report — documented — but the bag stays valid)
+    bag = CBag.create(4).push_(2)
+    bag, popped, ok = bag.pop_(jnp.asarray([2, 2]))
+    assert int(bag.counts[2]) == 0
+    assert int(bag.total) == 0

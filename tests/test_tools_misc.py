@@ -108,6 +108,20 @@ def test_tensormaker():
     assert np.allclose(np.asarray(sym[0::2]), -np.asarray(sym[1::2]))
     ri = o.make_randint(num_solutions=6, n=3)
     assert int(jnp.min(ri)) >= 0 and int(jnp.max(ri)) < 3
+    # make_tensor (reference tensormaker.py:142): owner dtype by default
+    t = o.make_tensor([[1, 2], [3, 4]])
+    assert t.dtype == jnp.float32 and t.shape == (2, 2)
+    assert o.make_tensor([1], dtype=jnp.int32).dtype == jnp.int32
+    obj = o.make_tensor(["a_string", (1, 2)], dtype=object)
+    assert len(obj) == 2 and obj[0] == "a_string"
+    ro = o.make_tensor(["x"], dtype=object, read_only=True)
+    assert ro.is_read_only
+    # *_shaped_like (reference tensormaker.py:866,893)
+    template = jnp.zeros((3, 2), dtype=jnp.float32)
+    us = o.make_uniform_shaped_like(template, lb=0.5, ub=1.5)
+    assert us.shape == (3, 2) and float(jnp.min(us)) >= 0.5
+    gs = o.make_gaussian_shaped_like(template, center=7.0, stdev=0.0)
+    assert gs.shape == (3, 2) and np.allclose(np.asarray(gs), 7.0)
 
 
 def test_ensure_array_object_dtype():
