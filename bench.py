@@ -34,7 +34,12 @@ def main():
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-    from evotorch_tpu.algorithms.functional import pgpe_ask, pgpe_tell
+    from evotorch_tpu.algorithms.functional import (
+        pgpe_ask,
+        pgpe_ask_lowrank,
+        pgpe_tell,
+        pgpe_tell_lowrank,
+    )
     from evotorch_tpu.envs import make_env
     from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
     from evotorch_tpu.neuroevolution.net.vecrl import (
@@ -48,6 +53,12 @@ def main():
     generations = cfg["generations"]
     compute_dtype = cfg["compute_dtype"]
     eval_mode = cfg["eval_mode"]
+    lowrank = cfg["lowrank"]
+    if lowrank:
+        ask = partial(pgpe_ask_lowrank, rank=lowrank)
+        tell = pgpe_tell_lowrank
+    else:
+        ask, tell = pgpe_ask, pgpe_tell
     env = make_env(cfg["env_name"], **cfg["env_kwargs"])
     policy = build_policy(env)
     print(
@@ -69,8 +80,8 @@ def main():
         """Run warmup + ``generations`` timed generations of one contract;
         returns (steps_per_sec, generations_per_sec, final state, key)."""
         if mode == "episodes_compact":
-            ask_jit = jax.jit(partial(pgpe_ask, popsize=popsize))
-            tell_jit = jax.jit(pgpe_tell)
+            ask_jit = jax.jit(partial(ask, popsize=popsize))
+            tell_jit = jax.jit(tell)
 
             def gen(state, key, prewarm=False):
                 k1, k2 = jax.random.split(key)
@@ -88,11 +99,11 @@ def main():
 
             def generation(state, key):
                 k1, k2 = jax.random.split(key)
-                values = pgpe_ask(k1, state, popsize=popsize)
+                values = ask(k1, state, popsize=popsize)
                 result = run_vectorized_rollout(
                     env, policy, values, k2, stats, eval_mode=mode, **rollout_kwargs
                 )
-                state = pgpe_tell(state, values, result.scores)
+                state = tell(state, values, result.scores)
                 return state, result.total_steps, result.scores
 
             gen = jax.jit(generation)
@@ -145,6 +156,7 @@ def main():
                 "popsize": popsize,
                 "episode_length": episode_length,
                 "eval_mode": eval_mode,
+                "lowrank": lowrank,
                 "compute_dtype": str(compute_dtype.__name__ if compute_dtype else "float32"),
                 "backend": "cpu-fallback" if use_cpu else "tpu",
             }

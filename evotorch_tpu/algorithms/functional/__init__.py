@@ -11,7 +11,14 @@ from .funccem import CEMState, cem, cem_ask, cem_tell
 from .funcga import GAState, default_variation, ga, ga_ask, ga_tell
 from .funccmaes import CMAESState, cmaes, cmaes_ask, cmaes_tell
 from .funcmapelites import MAPElitesState, mapelites, mapelites_ask, mapelites_tell
-from .funcpgpe import PGPEState, pgpe, pgpe_ask, pgpe_tell
+from .funcpgpe import (
+    PGPEState,
+    pgpe,
+    pgpe_ask,
+    pgpe_ask_lowrank,
+    pgpe_tell,
+    pgpe_tell_lowrank,
+)
 from .funcsnes import SNESState, snes, snes_ask, snes_tell
 from .funcxnes import XNESState, xnes, xnes_ask, xnes_tell
 from .funcsgd import SGDState, sgd, sgd_ask, sgd_tell
@@ -47,6 +54,8 @@ __all__ = [
     "pgpe",
     "pgpe_ask",
     "pgpe_tell",
+    "pgpe_ask_lowrank",
+    "pgpe_tell_lowrank",
     "SNESState",
     "snes",
     "snes_ask",

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..net.functional import FlatParamsPolicy
+from ..net.lowrank import LowRankParamsBatch, lowrank_forward, prepare_lowrank
 from ..net.rl import alive_bonus_for_step
 from ..net.runningnorm import CollectedStats, stats_normalize, stats_update
 
@@ -39,6 +40,49 @@ __all__ = [
     "run_vectorized_rollout_compacting",
     "RolloutResult",
 ]
+
+
+# ------------------- population-parameter representations -------------------
+# The engine accepts a population either as a dense (N, L) matrix or as a
+# LowRankParamsBatch (center + shared basis + per-lane coefficients — the MXU
+# path for wide policies, net/lowrank.py). These helpers are the only places
+# that care which one it is.
+
+
+def _params_popsize(params_batch) -> int:
+    if isinstance(params_batch, LowRankParamsBatch):
+        return params_batch.popsize
+    return params_batch.shape[0]
+
+
+def _params_cast(params_batch, dtype):
+    if dtype is None:
+        return params_batch
+    return jax.tree_util.tree_map(lambda x: x.astype(dtype), params_batch)
+
+
+def _params_take(params_batch, idx):
+    if isinstance(params_batch, LowRankParamsBatch):
+        return params_batch.take(idx)
+    return params_batch[idx]
+
+
+def _forward_ctx(policy, params_batch):
+    """Precompute the loop-invariant forward context (per-layer center/basis
+    trees for the low-rank path); call inside jit, OUTSIDE stepping loops."""
+    if isinstance(params_batch, LowRankParamsBatch):
+        return prepare_lowrank(policy, params_batch)
+    return None
+
+
+def _batched_forward(policy, params_batch, ctx, obs, states):
+    """Whole-population policy forward for either representation."""
+    if isinstance(params_batch, LowRankParamsBatch):
+        return lowrank_forward(policy, params_batch, ctx, obs, states)
+    if states is None:
+        out, _ = jax.vmap(lambda p, o: policy(p, o))(params_batch, obs)
+        return out, None
+    return jax.vmap(policy)(params_batch, obs, states)
 
 
 def reset_tensors(tree: Any, mask: jnp.ndarray) -> Any:
@@ -208,9 +252,8 @@ def _rollout_init(
     compute_dtype,
 ):
     """Build the initial carry (full width) and the compute-dtype params."""
-    n = params_batch.shape[0]
-    if compute_dtype is not None:
-        params_batch = params_batch.astype(compute_dtype)
+    n = _params_popsize(params_batch)
+    params_batch = _params_cast(params_batch, compute_dtype)
 
     key, sub = jax.random.split(key)
     reset_keys = jax.random.split(sub, n)
@@ -277,7 +320,7 @@ def _make_step(
     """
     auto_reset = budget_mode or num_episodes > 1
 
-    def step(params_batch: jnp.ndarray, c: RolloutCarry) -> RolloutCarry:
+    def step(params_batch, ctx, c: RolloutCarry) -> RolloutCarry:
         n = c.active.shape[0]
         key, noise_key, reset_key = jax.random.split(c.key, 3)
 
@@ -286,14 +329,9 @@ def _make_step(
         )
         if compute_dtype is not None:
             policy_in = policy_in.astype(compute_dtype)
-        if c.policy_states is None:
-            raw, new_policy_states = jax.vmap(lambda p, o: policy(p, o))(
-                params_batch, policy_in
-            )
-        else:
-            raw, new_policy_states = jax.vmap(policy)(
-                params_batch, policy_in, c.policy_states
-            )
+        raw, new_policy_states = _batched_forward(
+            policy, params_batch, ctx, policy_in, c.policy_states
+        )
         if compute_dtype is not None:
             raw = raw.astype(jnp.float32)
 
@@ -482,9 +520,12 @@ def run_vectorized_rollout(
         budget_mode=budget_mode,
     )
 
+    ctx = _forward_ctx(policy, params_batch)
     if budget_mode:
         budget = max_t * int(num_episodes)
-        final = jax.lax.fori_loop(0, budget, lambda _, c: step(params_batch, c), carry)
+        final = jax.lax.fori_loop(
+            0, budget, lambda _, c: step(params_batch, ctx, c), carry
+        )
         # average episodic return over the budget: completed episodes plus
         # the fractional trailing one (exactly the episodic mean whenever the
         # budget lands on an episode boundary)
@@ -497,7 +538,7 @@ def run_vectorized_rollout(
         def cond(c: RolloutCarry):
             return jnp.any(c.active) & (c.t_global < hard_cap)
 
-        final = jax.lax.while_loop(cond, lambda c: step(params_batch, c), carry)
+        final = jax.lax.while_loop(cond, lambda c: step(params_batch, ctx, c), carry)
         mean_scores = final.scores / jnp.maximum(final.episodes_done, 1)
     return RolloutResult(
         scores=mean_scores,
@@ -559,13 +600,15 @@ def _compacting_fns(
 
     @partial(jax.jit, static_argnames=("num_steps",))
     def chunk_fn(params_batch, carry, num_steps: int):
+        ctx = _forward_ctx(policy, params_batch)  # loop-invariant, per chunk
+
         def cond(s):
             i, c = s
             return (i < num_steps) & jnp.any(c.active) & (c.t_global < hard_cap)
 
         def body(s):
             i, c = s
-            return i + 1, step(params_batch, c)
+            return i + 1, step(params_batch, ctx, c)
 
         _, out = jax.lax.while_loop(cond, body, (jnp.zeros((), jnp.int32), carry))
         return out, jnp.sum(out.active.astype(jnp.int32))
@@ -595,7 +638,7 @@ def _compacting_fns(
             total_steps=carry.total_steps,
             t_global=carry.t_global,
         )
-        return new_carry, params_batch[sel], lane_ids[sel], scores_buf, eps_buf
+        return new_carry, _params_take(params_batch, sel), lane_ids[sel], scores_buf, eps_buf
 
     @jax.jit
     def finalize_fn(carry, lane_ids, scores_buf, eps_buf):
@@ -645,10 +688,11 @@ def run_vectorized_rollout_compacting(
       chunk is dispatched before the previous chunk's active-count is read,
       so the device never sits idle waiting on the host round-trip (which
       matters on tunneled TPU links).
-    - Widths come from a small fixed menu (``allowed_widths``, default
-      ``{N} ∪ {powers of two in [min_width, N/2]}`` with at most 4 entries),
-      and the width descends at most one menu step per chunk — so the set of
-      XLA compilations is exactly the chain of adjacent width pairs, which
+    - The working width starts at N and descends through a small fixed menu
+      (``allowed_widths``, default: the powers of two in
+      ``[max(256, pow2(N/16)), N/2]`` — at most 4 entries for the default
+      ``min_width``), at most one menu step per chunk — so the set of XLA
+      compilations is exactly the chain of adjacent width pairs, which
       ``prewarm=True`` compiles up front (so a later, deeper compaction never
       drops a compile into someone's timing loop).
     - Results are scattered into full-width device buffers keyed by original
@@ -665,7 +709,7 @@ def run_vectorized_rollout_compacting(
     Not traceable (it syncs lane counts to the host); use the monolithic
     runner inside jit/shard_map.
     """
-    n = params_batch.shape[0]
+    n = _params_popsize(params_batch)
     max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
     if episode_length is not None:
         max_t = min(max_t, int(episode_length))
