@@ -111,9 +111,15 @@ def test_sharded_grad_estimator_converges():
     assert float(jnp.linalg.norm(mu)) < 1.0
 
 
-def test_dryrun_multichip_various_topologies():
+def test_dryrun_multichip_various_topologies(monkeypatch):
     import __graft_entry__ as g
 
+    # small flagship sizes: this test is about topology (divisibility,
+    # odd device counts), not scale — the driver's n=8 dryrun covers the
+    # flagship-scale step. popsize 10 on 3 devices exercises the
+    # lcm(2, n_devices) rounding (10 -> 6, even AND divisible by 3).
+    monkeypatch.setenv("MULTICHIP_POPSIZE", "10")
+    monkeypatch.setenv("MULTICHIP_EPISODE_LENGTH", "5")
     # even and odd device counts; both must compile + execute
     g.dryrun_multichip(2)
     g.dryrun_multichip(3)
