@@ -70,6 +70,7 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         distributed: bool = False,
         popsize_weighted_grad_avg: Optional[bool] = None,
         ensure_even_popsize: bool = False,
+        lowrank_rank: Optional[int] = None,
     ):
         problem.ensure_numeric()
         problem.ensure_unbounded()
@@ -105,6 +106,34 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         dist_params = deepcopy(self.DISTRIBUTION_PARAMS) if self.DISTRIBUTION_PARAMS is not None else {}
         dist_params.update({"mu": mu, "sigma": sigma})
         self._distribution: Distribution = dist_cls(dist_params, dtype=problem.dtype)
+
+        # factored (low-rank) population mode: the MXU path for wide policies
+        # (tools/lowrank.py; sampling + gradients on the distribution class)
+        self._lowrank_rank = None if lowrank_rank is None else int(lowrank_rank)
+        if self._lowrank_rank is not None:
+            if self._lowrank_rank < 1:
+                raise ValueError(f"lowrank_rank must be >= 1, got {lowrank_rank}")
+            if distributed:
+                raise ValueError(
+                    "lowrank_rank is not available in distributed mode: the "
+                    "factored population is already the bandwidth-optimal "
+                    "representation for sharded evaluation (VecNE shards the "
+                    "coefficients); combine lowrank_rank with num_actors on "
+                    "the problem instead"
+                )
+            if num_interactions is not None:
+                raise ValueError(
+                    "lowrank_rank cannot be combined with num_interactions: "
+                    "the adaptive-popsize loop concatenates per-round batches, "
+                    "and factored batches with different bases cannot "
+                    "concatenate"
+                )
+            if not hasattr(dist_cls, "_sample_lowrank"):
+                raise ValueError(
+                    f"{dist_cls.__name__} has no factored sampler; "
+                    "lowrank_rank requires symmetric PGPE "
+                    "(SymmetricSeparableGaussian)"
+                )
 
         self._popsize = int(popsize)
         self._popsize_max = None if popsize_max is None else int(popsize_max)
@@ -199,6 +228,11 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
 
     # -------------------------------------------------------- non-distributed
     def _sample_population(self, popsize: int) -> SolutionBatch:
+        if self._lowrank_rank is not None:
+            samples = self._distribution.sample_lowrank(
+                popsize, self._lowrank_rank, key=self._problem.next_rng_key()
+            )
+            return SolutionBatch(self._problem, values=samples)
         samples = self._distribution.sample(popsize, key=self._problem.next_rng_key())
         return SolutionBatch(self._problem, samples.shape[0], values=samples)
 
@@ -337,7 +371,10 @@ class PGPE(GaussianSearchAlgorithm):
         obj_index: Optional[int] = None,
         distributed: bool = False,
         popsize_weighted_grad_avg: Optional[bool] = None,
+        lowrank_rank: Optional[int] = None,
     ):
+        if lowrank_rank is not None and not symmetric:
+            raise ValueError("lowrank_rank requires symmetric=True (the PGPE default)")
         if symmetric:
             self.DISTRIBUTION_TYPE = SymmetricSeparableGaussian
             divide_by = "num_directions"
@@ -368,6 +405,7 @@ class PGPE(GaussianSearchAlgorithm):
             distributed=distributed,
             popsize_weighted_grad_avg=popsize_weighted_grad_avg,
             ensure_even_popsize=symmetric,
+            lowrank_rank=lowrank_rank,
         )
 
 

@@ -44,6 +44,7 @@ from .operators.functional import pareto_ranks, pareto_utility
 from .tools.cloning import Serializable, deep_clone
 from .tools.hook import Hook
 from .tools.lazyreporter import LazyReporter
+from .tools.lowrank import LowRankParamsBatch, dense_values
 from .tools.misc import (
     ensure_array_length_and_dtype,
     is_dtype_bool,
@@ -439,8 +440,9 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
 
     def _eval_possibly_sharded(self, batch: "SolutionBatch"):
         if self._sharded_evaluator is not None:
+            values = dense_values(batch.values)
             try:
-                evals = self._sharded_evaluator(batch.values)
+                evals = self._sharded_evaluator(values)
             except jax.errors.JAXTypeError as e:
                 # the objective turned out not to be jax-traceable (tracer
                 # leaked into host code — the reference runs arbitrary Python
@@ -553,7 +555,9 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
             pieces = batch.split(min(pool.num_workers, len(batch)))
         sync = self._make_sync_data_for_actors()
         try:
-            evals, sync_back = pool.evaluate_pieces([p.values for p in pieces], sync)
+            evals, sync_back = pool.evaluate_pieces(
+                [dense_values(p.values) for p in pieces], sync
+            )
         except Exception:
             # the pool shut itself down on failure; drop the dead handle so a
             # later evaluate does not enqueue into a pool with no workers
@@ -582,17 +586,23 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
 
     def _evaluate_batch(self, batch: "SolutionBatch"):
         """Vectorized objective call or per-solution loop
-        (reference ``core.py:2602-2621``)."""
+        (reference ``core.py:2602-2621``).
+
+        A factored (low-rank) population is materialized at this boundary:
+        plain fitness functions are functions of dense vectors. Problems
+        whose evaluator understands the factored form natively (``VecNE``)
+        override this method and keep it factored."""
         if self._vectorized and self._objective_func is not None:
-            result = self._objective_func(batch.values)
+            result = self._objective_func(dense_values(batch.values))
             batch.set_evals(*self._split_eval_outputs(result))
         elif self._objective_func is not None and not is_dtype_object(self._dtype):
             # per-solution loop, but accumulate host-side and scatter once —
             # avoids rebuilding the (N, W) eval matrix N times
+            values = dense_values(batch.values)
             rows = []
             width = self.num_objectives + self._eval_data_length
             for i in range(len(batch)):
-                result = self._objective_func(batch.values[i])
+                result = self._objective_func(values[i])
                 row = np.atleast_1d(np.asarray(result, dtype=np.float64))
                 if row.shape[0] < width:
                     row = np.concatenate([row, np.full(width - row.shape[0], np.nan)])
@@ -652,7 +662,15 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         # to one pinned device for the running merge — batches may arrive
         # from programs compiled over different meshes, and mixing their
         # placements in one jit call is an error
-        cbv, cbe, cwv, cwe = _batch_extremes(batch.values, batch.evals, senses)
+        values = batch.values
+        if isinstance(values, LowRankParamsBatch):
+            # find the winner COEFFICIENT rows, then densify only those K
+            # rows — the full (N, L) population is never built
+            cbv, cbe, cwv, cwe = _batch_extremes(values.coeffs, batch.evals, senses)
+            cbv = values.materialize_rows(cbv)
+            cwv = values.materialize_rows(cwv)
+        else:
+            cbv, cbe, cwv, cwe = _batch_extremes(values, batch.evals, senses)
         dev = jax.devices()[0]
         put = functools.partial(jax.device_put, device=dev)
         bv, be, wv, we = _merge_snapshots(
@@ -1056,6 +1074,14 @@ class SolutionBatch(Serializable, RecursivePrintable):
                 raise ValueError("merging_of needs at least one batch")
             first = batches[0]
             self._problem = first._problem
+            if any(isinstance(b._values, LowRankParamsBatch) for b in batches):
+                raise TypeError(
+                    "Low-rank (factored) batches cannot be concatenated: each "
+                    "generation has its own basis, so a merged population has "
+                    "no shared factored form. Materialize first "
+                    "(batch.values.materialize()) or avoid popsize-adaptive "
+                    "modes (num_interactions) with lowrank_rank."
+                )
             if isinstance(first._values, ObjectArray):
                 merged = []
                 for b in batches:
@@ -1083,6 +1109,9 @@ class SolutionBatch(Serializable, RecursivePrintable):
                     # fancy indexing copies; writes propagate via
                     # _scatter_object_values instead
                     self._values = source._values[list(indices)]
+            elif isinstance(source._values, LowRankParamsBatch):
+                # gather coefficient lanes; center/basis are shared
+                self._values = source._values.take(jnp.asarray(indices))
             else:
                 self._values = source._values[jnp.asarray(indices)]
             self._evdata = source._evdata[jnp.asarray(indices)]
@@ -1102,6 +1131,11 @@ class SolutionBatch(Serializable, RecursivePrintable):
             if isinstance(values, ObjectArray):
                 self._values = values
                 popsize = len(values)
+            elif isinstance(values, LowRankParamsBatch):
+                # factored population: theta_i = center + basis @ coeffs[i]
+                # stored as-is — the dense (N, L) matrix is never built here
+                self._values = values
+                popsize = values.popsize
             else:
                 values = jnp.asarray(values, dtype=problem.dtype)
                 if values.ndim != 2:
@@ -1135,11 +1169,16 @@ class SolutionBatch(Serializable, RecursivePrintable):
     def __len__(self) -> int:
         if isinstance(self._values, ObjectArray):
             return len(self._values)
+        if isinstance(self._values, LowRankParamsBatch):
+            return self._values.popsize
         return int(self._values.shape[0])
 
     @property
-    def values(self) -> Union[jnp.ndarray, ObjectArray]:
-        """Read-only view of decision values (reference ``core.py:4088``)."""
+    def values(self) -> Union[jnp.ndarray, ObjectArray, LowRankParamsBatch]:
+        """Read-only view of decision values (reference ``core.py:4088``).
+        For a factored population this is the ``LowRankParamsBatch`` itself
+        (immutable by construction); call ``.materialize()`` on it if a dense
+        matrix is genuinely needed."""
         if isinstance(self._values, ObjectArray):
             return self._values.get_read_only_view()
         return self._values
@@ -1177,6 +1216,27 @@ class SolutionBatch(Serializable, RecursivePrintable):
 
     def set_values(self, values, *, keep_evals: bool = False):
         """Replace decision values (reference ``core.py:3950``)."""
+        if isinstance(self._values, LowRankParamsBatch):
+            if not isinstance(values, LowRankParamsBatch):
+                raise TypeError(
+                    "This batch holds a factored (low-rank) population; "
+                    "set_values expects another LowRankParamsBatch of the "
+                    "same popsize"
+                )
+            if values.popsize != len(self):
+                raise ValueError(
+                    f"set_values popsize mismatch: {values.popsize} vs {len(self)}"
+                )
+            if self._parent is not None:
+                raise NotImplementedError(
+                    "Writing values into a slice view of a factored batch is "
+                    "not supported (coefficient scatter-back is ambiguous "
+                    "across bases)"
+                )
+            self._values = values
+            if not keep_evals:
+                self.forget_evals()
+            return
         if isinstance(self._values, ObjectArray):
             if len(values) != len(self):
                 raise ValueError("Length mismatch in set_values")
@@ -1450,6 +1510,10 @@ class Solution(Serializable, RecursivePrintable):
 
     @property
     def values(self):
+        if isinstance(self._batch._values, LowRankParamsBatch):
+            # densify just this row: center + basis @ coeffs[i]
+            lr = self._batch._values
+            return lr.materialize_rows(lr.coeffs[self._index][None])[0]
         return self._batch._values[self._index]
 
     @property
@@ -1462,6 +1526,12 @@ class Solution(Serializable, RecursivePrintable):
         return not bool(jnp.any(jnp.isnan(self.evals[:n_obj])))
 
     def set_values(self, values):
+        if isinstance(self._batch._values, LowRankParamsBatch):
+            raise NotImplementedError(
+                "Writing a single solution's values into a factored "
+                "(low-rank) batch is not supported: an arbitrary dense row "
+                "generally has no representation in the batch's basis"
+            )
         if isinstance(self._batch._values, ObjectArray):
             self._batch._values[self._index] = values
             if self._batch._parent is not None:
@@ -1518,6 +1588,8 @@ class Solution(Serializable, RecursivePrintable):
         problem = self.problem
         if isinstance(self._batch._values, ObjectArray):
             values = ObjectArray.from_values([self._batch._values[self._index]])
+        elif isinstance(self._batch._values, LowRankParamsBatch):
+            values = self.values[None]
         else:
             values = self._batch._values[self._index][None]
         new_batch = SolutionBatch(problem, 1, values=values, evals=self._batch._evdata[self._index][None])

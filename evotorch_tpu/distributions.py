@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from .tools.cloning import Serializable
+from .tools.lowrank import LowRankParamsBatch
 from .tools.misc import to_jax_dtype
 from .tools.ranking import rank
 from .tools.recursiveprintable import RecursivePrintable
@@ -51,6 +52,7 @@ __all__ = [
 # classmethods, so one compiled executable per (class, static-config) pair
 # serves every instance and every generation
 _JITTED_SAMPLE_CACHE: dict = {}
+_JITTED_SAMPLE_LOWRANK_CACHE: dict = {}
 _JITTED_GRADS_CACHE: dict = {}
 
 
@@ -78,6 +80,20 @@ def _jitted_sample_for(cls):
 
         fn = jax.jit(sample, static_argnames=("static_items", "num_solutions"))
         _JITTED_SAMPLE_CACHE[cls] = fn
+    return fn
+
+
+def _jitted_sample_lowrank_for(cls):
+    fn = _JITTED_SAMPLE_LOWRANK_CACHE.get(cls)
+    if fn is None:
+
+        def sample(key, array_params, static_items, num_solutions, rank):
+            params = dict(array_params)
+            params.update(dict(static_items))
+            return cls._sample_lowrank(key, params, num_solutions, rank)
+
+        fn = jax.jit(sample, static_argnames=("static_items", "num_solutions", "rank"))
+        _JITTED_SAMPLE_LOWRANK_CACHE[cls] = fn
     return fn
 
 
@@ -188,8 +204,10 @@ class Distribution(TensorMakerMixin, Serializable, RecursivePrintable):
             raise ValueError(f"objective_sense must be 'min' or 'max', got {objective_sense!r}")
         higher_is_better = objective_sense == "max"
         arrays, static = _split_params(self._parameters)
+        if not isinstance(samples, LowRankParamsBatch):
+            samples = jnp.asarray(samples)  # structured samples are pytrees already
         return _jitted_grads_for(type(self))(
-            arrays, jnp.asarray(samples), jnp.asarray(fitnesses), static, ranking_method, higher_is_better
+            arrays, samples, jnp.asarray(fitnesses), static, ranking_method, higher_is_better
         )
 
     @classmethod
@@ -381,6 +399,8 @@ class SymmetricSeparableGaussian(SeparableGaussian):
 
     @classmethod
     def _compute_gradients(cls, parameters, samples, weights, ranking_used) -> dict:
+        if isinstance(samples, LowRankParamsBatch):
+            return cls._compute_gradients_lowrank(parameters, samples, weights, ranking_used)
         if "parenthood_ratio" in parameters:
             return cls._compute_gradients_via_parenthood_ratio(parameters, samples, weights)
         mu = parameters["mu"]
@@ -397,6 +417,81 @@ class SymmetricSeparableGaussian(SeparableGaussian):
             "sigma",
             ((fdplus + fdminus) / 2) @ ((scaled_noises**2 - sigma**2) / sigma),
             weights,
+        )
+        return {"mu": mu_grad, "sigma": sigma_grad}
+
+    # ------------------- factored (low-rank) population mode -----------------
+    # The MXU path for wide policies (tools/lowrank.py): the population is
+    # theta_i = mu + (sigma * B) z_i with a shared per-generation basis
+    # B (L, rank) and per-lane coefficients z_i — and both the sampling and
+    # the gradient estimate factor through the basis, so the dense (N, L)
+    # population matrix is never materialized. With B entries ~ N(0, 1/rank)
+    # the per-coordinate marginal variance of a perturbation is sigma^2 in
+    # expectation over the basis (for a fixed per-generation basis the
+    # per-coordinate variance fluctuates with relative stddev ~sqrt(2/rank),
+    # so sigma-adaptation calibration is noisier at small rank).
+    #
+    # No reference counterpart (the reference evaluates dense populations
+    # only); the math below is this class's dense symmetric gradient
+    # rewritten in factored form:
+    #   scaled_noises = B_eff Z^T            (never built)
+    #   mu_grad    = B_eff @ (((f+ - f-)/2) @ Z)
+    #   sigma_grad = (rowquad(B_eff, Z^T diag((f+ + f-)/2) Z)
+    #                 - sum((f+ + f-)/2) sigma^2) / sigma
+    # which equal the dense formulas exactly (tested in test_lowrank.py).
+
+    @classmethod
+    def _sample_lowrank(cls, key, parameters, num_solutions, rank):
+        """Draw a ``LowRankParamsBatch``: antithetic coefficient pairs
+        interleaved ``[+z0, -z0, +z1, -z1, ...]`` (the dense sampler's
+        direction layout above), sigma folded into the basis."""
+        if num_solutions % 2 != 0:
+            raise ValueError(
+                f"Number of solutions sampled from {cls.__name__} must be even,"
+                f" got {num_solutions}"
+            )
+        mu = parameters["mu"]
+        sigma = parameters["sigma"]
+        rank = int(rank)
+        key_basis, key_coeffs = jax.random.split(key)
+        basis = jax.random.normal(key_basis, (mu.shape[-1], rank), dtype=mu.dtype) / jnp.sqrt(
+            jnp.asarray(float(rank), mu.dtype)
+        )
+        basis = sigma[..., None] * basis  # sigma folded in: delta = basis @ z
+        num_directions = num_solutions // 2
+        z = jax.random.normal(key_coeffs, (num_directions, rank), dtype=mu.dtype)
+        coeffs = jnp.stack([z, -z], axis=1).reshape(num_solutions, rank)
+        return LowRankParamsBatch(center=mu, basis=basis, coeffs=coeffs)
+
+    def sample_lowrank(self, num_solutions: int, rank: int, *, key=None) -> LowRankParamsBatch:
+        """Stateful-API counterpart of :meth:`_sample_lowrank` (jitted per
+        class like :meth:`sample`)."""
+        if key is None:
+            key = self.next_rng_key()
+        arrays, static = _split_params(self._parameters)
+        return _jitted_sample_lowrank_for(type(self))(
+            key, arrays, static, int(num_solutions), int(rank)
+        )
+
+    @classmethod
+    def _compute_gradients_lowrank(cls, parameters, samples: LowRankParamsBatch, weights, ranking_used) -> dict:
+        """The dense symmetric gradients computed in O(L * rank) from the
+        factored population — numerically identical to running
+        ``_compute_gradients`` on ``samples.materialize()``."""
+        sigma = parameters["sigma"]
+        weights = _zero_center_weights(weights, ranking_used)
+        z = samples.coeffs[0::2]  # (D, rank): the +z of each antithetic pair
+        basis = samples.basis  # sigma-folded effective basis (L, rank)
+        fdplus = weights[0::2]
+        fdminus = weights[1::2]
+        mu_grad = _divide_grad(
+            parameters, "mu", basis @ (((fdplus - fdminus) / 2) @ z), weights
+        )
+        w_s = (fdplus + fdminus) / 2
+        m = z.T @ (w_s[:, None] * z)  # (rank, rank)
+        rowquad = jnp.einsum("lm,mn,ln->l", basis, m, basis)
+        sigma_grad = _divide_grad(
+            parameters, "sigma", (rowquad - jnp.sum(w_s) * sigma**2) / sigma, weights
         )
         return {"mu": mu_grad, "sigma": sigma_grad}
 

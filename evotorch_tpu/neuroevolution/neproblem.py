@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import Problem, SolutionBatch
+from ..tools.lowrank import dense_values
 from .net.functional import FlatParamsPolicy
 from .net.layers import Module
 from .net.parser import str_to_net
@@ -130,7 +131,9 @@ class NEProblem(BaseNEProblem):
         return self._network_eval_func(self._policy, flat_params)
 
     def _evaluate_batch(self, batch: SolutionBatch):
-        values = jnp.asarray(batch.values)
+        # factored populations densify here: a per-network eval function
+        # needs dense parameter vectors (VecNE keeps it factored instead)
+        values = jnp.asarray(dense_values(batch.values))
         if self._vectorized_network_eval:
             results = jax.vmap(self._evaluate_network)(values)
             batch.set_evals(*self._split_eval_outputs(results))

@@ -19,11 +19,17 @@ shared direction matrices ``D_m``, and the whole population's forward is
 the (N, L) population matrix is never materialized at all (for a 256x256
 policy at popsize 10k that matrix alone is 3.9 GB).
 
-``LowRankParamsBatch`` is the population representation; the rollout engine
-(``vecrl.py``) accepts it anywhere it accepts a dense ``(N, L)`` matrix.
-Modules without a structured path (RNN/LSTM, custom) fall back to
+Recurrent cells get the same treatment: an RNN/LSTM step is two matmuls
+(input-to-hidden and hidden-to-hidden), each of which augments exactly like
+a Linear — so recurrent policies run the MXU path at full speed too, with
+the per-lane hidden state threaded through unchanged (VERDICT r3 #4).
+
+``LowRankParamsBatch`` is the population representation (defined in
+``tools/lowrank.py`` so core/distributions can speak it too); the rollout
+engine (``vecrl.py``) accepts it anywhere it accepts a dense ``(N, L)``
+matrix. Modules without a structured path (custom/unstructured) fall back to
 materializing the dense population — correct everywhere, fast where it
-matters.
+matters, and LOUD (a trace-time warning) when the fallback fires.
 
 No reference counterpart: the reference evaluates dense populations only
 (``distributions.py:616-773`` samples full vectors); this is a TPU-first
@@ -32,53 +38,25 @@ framework feature (VERDICT r2 #2).
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from .layers import Bias, Linear, Module, Sequential
+from ...tools.lowrank import LowRankParamsBatch
+from .layers import LSTM, RNN, Bias, Linear, Module, Sequential
 
 __all__ = ["LowRankParamsBatch", "lowrank_supported", "prepare_lowrank", "lowrank_forward"]
 
 
-class LowRankParamsBatch(NamedTuple):
-    """A population expressed as ``theta_i = center + basis @ coeffs[i]``.
-
-    ``basis`` is the *effective* basis: per-generation direction matrix with
-    any per-parameter scale (e.g. PGPE's sigma) already folded in.
-    """
-
-    center: jnp.ndarray  # (L,)
-    basis: jnp.ndarray  # (L, k)
-    coeffs: jnp.ndarray  # (N, k)
-
-    @property
-    def popsize(self) -> int:
-        return self.coeffs.shape[0]
-
-    @property
-    def rank(self) -> int:
-        return self.basis.shape[-1]
-
-    def take(self, idx) -> "LowRankParamsBatch":
-        """Gather lanes (the rollout engine's compaction); center/basis are
-        shared across lanes and ride along untouched."""
-        return LowRankParamsBatch(self.center, self.basis, self.coeffs[idx])
-
-    def materialize(self) -> jnp.ndarray:
-        """The dense ``(N, L)`` population (the correctness fallback — avoid
-        on the hot path; this is exactly the matrix the representation
-        exists to not build)."""
-        return self.center + self.coeffs @ self.basis.T
-
-
 def lowrank_supported(module: Module) -> bool:
-    """True when the module stack has a structured low-rank forward (today:
-    Sequential pipelines of Linear / Bias / parameterless layers)."""
+    """True when the module stack has a structured low-rank forward:
+    Sequential pipelines of Linear / Bias / RNN / LSTM / parameterless
+    layers."""
     if isinstance(module, Sequential):
         return all(lowrank_supported(m) for m in module.modules)
-    if isinstance(module, (Linear, Bias)):
+    if isinstance(module, (Linear, Bias, RNN, LSTM)):
         return True
     # parameterless layers (activations, Clip, Slice, ...) pass through
     return _is_parameterless(module)
@@ -109,13 +87,12 @@ def prepare_lowrank(policy, params: LowRankParamsBatch) -> _Prepared:
     return _Prepared(center_tree, basis_tree, params.coeffs)
 
 
-def _linear_lowrank(layer: Linear, cp, bp, z, x):
-    """``x``: (B, in); returns (B, out). One augmented dense matmul: the
-    center weight and the k direction matrices stacked row-wise, so the MXU
-    sees a single (B, in) @ (in, (k+1)*out) contraction; the per-lane
-    combination is a cheap VPU epilogue."""
-    W_c = cp["weight"]  # (out, in)
-    W_b = bp["weight"]  # (out, in, k)
+def _augmented_matmul(W_c, W_b, z, x):
+    """``x`` (B, in) times the per-lane effective weight
+    ``W_i = W_c + sum_m z_im W_b[..., m]``, computed as ONE augmented dense
+    matmul: the center weight and the k direction matrices stacked row-wise,
+    so the MXU sees a single (B, in) @ (in, (k+1)*out) contraction; the
+    per-lane combination is a cheap VPU epilogue. Returns (B, out)."""
     out_f, in_f = W_c.shape
     k = W_b.shape[-1]
     # (k, out, in) -> (k*out, in); stack center on top -> ((k+1)*out, in)
@@ -124,28 +101,90 @@ def _linear_lowrank(layer: Linear, cp, bp, z, x):
     y_aug = x @ W_aug.T  # (B, (k+1)*out)
     y = y_aug[:, :out_f]
     corr = y_aug[:, out_f:].reshape(-1, k, out_f)
-    y = y + jnp.einsum("bko,bk->bo", corr, z)
+    return y + jnp.einsum("bko,bk->bo", corr, z)
+
+
+def _lane_bias(cp_bias, bp_bias, z):
+    """Per-lane effective bias ``b_c + sum_m z_im b_b[:, m]`` -> (B, out)."""
+    return cp_bias + z @ bp_bias.T
+
+
+def _linear_lowrank(layer: Linear, cp, bp, z, x):
+    y = _augmented_matmul(cp["weight"], bp["weight"], z, x)
     if layer.bias:
-        y = y + cp["bias"] + z @ bp["bias"].T  # (B,k)@(k,out)
+        y = y + _lane_bias(cp["bias"], bp["bias"], z)
     return y
 
 
 def _bias_lowrank(layer: Bias, cp, bp, z, x):
-    return x + cp["bias"] + z @ bp["bias"].T
+    return x + _lane_bias(cp["bias"], bp["bias"], z)
 
 
-def _apply_lowrank(module: Module, cp, bp, z, x):
+def _rnn_lowrank(layer: RNN, cp, bp, z, x, state):
+    """Elman cell (layers.py:309): both matmuls augment like Linear; the
+    per-lane hidden state is just another (B, hidden) activation."""
+    if state is None:
+        state = jnp.zeros(x.shape[:-1] + (layer.hidden_size,), dtype=x.dtype)
+    pre = (
+        _augmented_matmul(cp["W_ih"], bp["W_ih"], z, x)
+        + _augmented_matmul(cp["W_hh"], bp["W_hh"], z, state)
+        + _lane_bias(cp["b_ih"], bp["b_ih"], z)
+        + _lane_bias(cp["b_hh"], bp["b_hh"], z)
+    )
+    h = jnp.tanh(pre) if layer.nonlinearity == "tanh" else jax.nn.relu(pre)
+    return h, h
+
+
+def _lstm_lowrank(layer: LSTM, cp, bp, z, x, state):
+    """LSTM cell (layers.py:350): the (4h, in) and (4h, h) gate matmuls
+    augment like Linear; gate nonlinearities are the same VPU epilogue as
+    the dense path."""
+    if state is None:
+        h = jnp.zeros(x.shape[:-1] + (layer.hidden_size,), dtype=x.dtype)
+        c = jnp.zeros(x.shape[:-1] + (layer.hidden_size,), dtype=x.dtype)
+    else:
+        h, c = state
+    gates = (
+        _augmented_matmul(cp["W_ih"], bp["W_ih"], z, x)
+        + _augmented_matmul(cp["W_hh"], bp["W_hh"], z, h)
+        + _lane_bias(cp["b_ih"], bp["b_ih"], z)
+        + _lane_bias(cp["b_hh"], bp["b_hh"], z)
+    )
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return h, (h, c)
+
+
+def _apply_lowrank(module: Module, cp, bp, z, x, state):
+    """Structured whole-population forward, threading per-lane recurrent
+    state exactly like ``Sequential.apply`` threads it in the dense path.
+    Returns ``(y, new_state)``."""
     if isinstance(module, Sequential):
-        for m, c, b in zip(module.modules, cp, bp):
-            x = _apply_lowrank(m, c, b, z, x)
-        return x
+        if state is None:
+            state = tuple(None for _ in module.modules)
+        new_states = []
+        for m, c, b, s in zip(module.modules, cp, bp, state):
+            x, ns = _apply_lowrank(m, c, b, z, x, s)
+            new_states.append(ns)
+        out_state = tuple(new_states)
+        if all(s is None for s in out_state):
+            out_state = None
+        return x, out_state
     if isinstance(module, Linear):
-        return _linear_lowrank(module, cp, bp, z, x)
+        return _linear_lowrank(module, cp, bp, z, x), state
     if isinstance(module, Bias):
-        return _bias_lowrank(module, cp, bp, z, x)
+        return _bias_lowrank(module, cp, bp, z, x), state
+    if isinstance(module, RNN):
+        return _rnn_lowrank(module, cp, bp, z, x, state)
+    if isinstance(module, LSTM):
+        return _lstm_lowrank(module, cp, bp, z, x, state)
     # parameterless layer: batched apply is the plain apply
-    y, _ = module.apply(cp, x, None)
-    return y
+    return module.apply(cp, x, state)
 
 
 def lowrank_forward(
@@ -153,17 +192,27 @@ def lowrank_forward(
 ) -> Tuple[jnp.ndarray, Any]:
     """Whole-population forward: ``obs`` (B, obs_dim) -> (B, act_dim).
     ``prepared`` may be None (computed on the fly — only sensible outside
-    hot loops)."""
+    hot loops). ``states`` is the batched per-lane state pytree (leading
+    axis B) for recurrent stacks, or None."""
     module = policy.module
-    if states is None and lowrank_supported(module):
+    if lowrank_supported(module):
         if prepared is None:
             prepared = prepare_lowrank(policy, params)
-        out = _apply_lowrank(
-            module, prepared.center_tree, prepared.basis_tree, prepared.coeffs, obs
+        return _apply_lowrank(
+            module, prepared.center_tree, prepared.basis_tree, prepared.coeffs, obs, states
         )
-        return out, None
     # fallback: materialize the dense population and vmap (correct for any
-    # module, including stateful/recurrent ones)
+    # module). Loud, not silent: the caller chose the low-rank representation
+    # to AVOID this matrix (VERDICT r3 #3) — the warning fires at trace time,
+    # once per compile
+    warnings.warn(
+        f"low-rank forward fell back to materializing the dense "
+        f"({params.popsize}, {params.center.shape[-1]}) population: "
+        f"{type(module).__name__} has no structured low-rank path "
+        "(supported: Sequential stacks of Linear/Bias/RNN/LSTM/"
+        "parameterless layers)",
+        stacklevel=2,
+    )
     dense = params.materialize()
     if states is None:
         return jax.vmap(lambda p, o: policy(p, o))(dense, obs)

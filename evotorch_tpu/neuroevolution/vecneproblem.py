@@ -23,6 +23,7 @@ import numpy as np
 
 from ..core import SolutionBatch
 from ..envs import Env, make_env
+from ..tools.lowrank import LowRankParamsBatch
 from ..parallel.mesh import default_mesh
 from .neproblem import NEProblem
 from .net.layers import Module
@@ -192,16 +193,23 @@ class VecNE(NEProblem):
         if mesh is not None:
             self.evaluate_sharded(batch, mesh=mesh)
             return
-        values = jnp.asarray(batch.values)
-        n = values.shape[0]
+        values = batch.values
+        if not isinstance(values, LowRankParamsBatch):
+            # a factored (low-rank) population stays factored all the way into
+            # the rollout engine — the dense (N, L) matrix is never built
+            values = jnp.asarray(values)
+        n = len(batch)
         if self._max_num_envs is not None and n > self._max_num_envs:
             # workload splitting (reference vecgymne.py:440-455): evaluate in
             # sub-batches of at most max_num_envs environments
             scores = []
             for start in range(0, n, self._max_num_envs):
-                result = self._rollout_batch(
-                    values[start : start + self._max_num_envs], self.next_rng_key()
+                piece = (
+                    values.take(jnp.arange(start, min(start + self._max_num_envs, n)))
+                    if isinstance(values, LowRankParamsBatch)
+                    else values[start : start + self._max_num_envs]
                 )
+                result = self._rollout_batch(piece, self.next_rng_key())
                 scores.append(result.scores)
                 self._consume_rollout_side_effects(result)
             batch.set_evals(jnp.concatenate(scores))
@@ -280,8 +288,11 @@ class VecNE(NEProblem):
         if mesh is None:
             mesh = default_mesh((axis_name,))
         n_shards = mesh.shape[axis_name]
-        values = jnp.asarray(batch.values)
-        n = values.shape[0]
+        values = batch.values
+        is_lowrank = isinstance(values, LowRankParamsBatch)
+        if not is_lowrank:
+            values = jnp.asarray(values)
+        n = len(batch)
         if n % n_shards != 0:
             raise ValueError(f"Population size {n} must be divisible by mesh size {n_shards}")
 
@@ -320,10 +331,18 @@ class VecNE(NEProblem):
                 jax.lax.psum(result.total_episodes, axis_name),
             )
 
+        # a factored population shards its per-lane COEFFICIENTS over the
+        # mesh; the shared center/basis replicate — per-device traffic is
+        # O(L*k + N_local*k) instead of O(N_local*L)
+        values_spec = (
+            LowRankParamsBatch(center=P(), basis=P(), coeffs=P(axis_name))
+            if is_lowrank
+            else P(axis_name)
+        )
         sharded = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(axis_name), P(), P()),
+            in_specs=(values_spec, P(), P()),
             out_specs=(P(axis_name), P(), P(), P()),
             check_vma=False,
         )

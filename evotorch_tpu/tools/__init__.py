@@ -3,10 +3,11 @@
 Parity target: reference ``src/evotorch/tools/`` (SURVEY.md §2.8).
 """
 
-from . import cloning, constraints, hook, immutable, misc, objectarray, pytree, ranking, readonlytensor, structures, tensorframe
+from . import cloning, constraints, hook, immutable, lowrank, misc, objectarray, pytree, ranking, readonlytensor, structures, tensorframe
 from .cloning import Clonable, ReadOnlyClonable, Serializable, deep_clone
 from .constraints import log_barrier, penalty, violation
 from .hook import Hook
+from .lowrank import LowRankParamsBatch
 from .immutable import (
     ImmutableContainer,
     ImmutableDict,
@@ -48,6 +49,7 @@ from .recursiveprintable import RecursivePrintable
 from .tensormaker import TensorMakerMixin
 
 __all__ = [
+    "LowRankParamsBatch",
     "Clonable",
     "ReadOnlyClonable",
     "Serializable",
