@@ -38,7 +38,10 @@ def main():
     from evotorch_tpu.algorithms.functional import pgpe_ask, pgpe_tell
     from evotorch_tpu.envs import make_env
     from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
-    from evotorch_tpu.neuroevolution.net.vecrl import run_vectorized_rollout
+    from evotorch_tpu.neuroevolution.net.vecrl import (
+        run_vectorized_rollout,
+        run_vectorized_rollout_compacting_sharded,
+    )
 
     cfg = bench_config(use_cpu, cpu_episode_length=50)
     popsize = cfg["popsize"]
@@ -46,11 +49,6 @@ def main():
     generations = cfg["generations"]
     compute_dtype = cfg["compute_dtype"]
     eval_mode = cfg["eval_mode"]
-    # the compacting runner is host-orchestrated and cannot run inside
-    # shard_map; the sharded bench evaluates the same contract monolithically
-    # (matching VecNE.evaluate_sharded)
-    if eval_mode == "episodes_compact":
-        eval_mode = "episodes"
 
     n_devices = len(jax.devices())
     mesh_size = int(os.environ.get("BENCH_MESH", n_devices))
@@ -106,14 +104,41 @@ def main():
 
     pop_sharding = NamedSharding(mesh, P("pop"))
 
-    @jax.jit
-    def generation(state, key, stats):
-        k1, k2 = jax.random.split(key)
-        values = pgpe_ask(k1, state, popsize=popsize)
-        values = jax.lax.with_sharding_constraint(values, pop_sharding)
-        scores, stats, per_shard_steps = sharded_rollout(values, k2, stats)
-        state = pgpe_tell(state, values, scores)
-        return state, stats, per_shard_steps, scores
+    if eval_mode == "episodes_compact":
+        # the sharded lane-compacting runner (host-orchestrated chunks over
+        # shard_mapped building blocks): ask and tell stay jitted programs
+        # around it, with the population pinned to the pop sharding
+        ask_jit = jax.jit(
+            lambda k, s: jax.lax.with_sharding_constraint(
+                pgpe_ask(k, s, popsize=popsize), pop_sharding
+            )
+        )
+        tell_jit = jax.jit(pgpe_tell)
+
+        def generation(state, key, stats):
+            k1, k2 = jax.random.split(key)
+            values = ask_jit(k1, state)
+            result, per_shard_steps = run_vectorized_rollout_compacting_sharded(
+                env, policy, values, k2, stats,
+                mesh=mesh,
+                num_episodes=1,
+                episode_length=episode_length,
+                compute_dtype=compute_dtype,
+                return_per_shard_steps=True,
+            )
+            state = tell_jit(state, values, result.scores)
+            return state, result.stats, per_shard_steps, result.scores
+
+    else:
+
+        @jax.jit
+        def generation(state, key, stats):
+            k1, k2 = jax.random.split(key)
+            values = pgpe_ask(k1, state, popsize=popsize)
+            values = jax.lax.with_sharding_constraint(values, pop_sharding)
+            scores, stats, per_shard_steps = sharded_rollout(values, k2, stats)
+            state = pgpe_tell(state, values, scores)
+            return state, stats, per_shard_steps, scores
 
     key = jax.random.key(0)
     key, sub = jax.random.split(key)

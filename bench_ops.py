@@ -1,0 +1,107 @@
+"""Micro-bench for the fused Pallas kernels (ops/) vs their XLA fallbacks.
+
+Run on the backend under test (TPU when the tunnel is healthy; the ranking
+kernel also interprets on CPU but interpret-mode timings are meaningless).
+Prints one JSON line per comparison; the dispatch policy in
+``tools/ranking.py`` (auto-fused on TPU for n <= 2048) and the opt-in flag
+``EVOTORCH_TPU_FUSED_SAMPLING`` are justified/refuted by these numbers —
+recorded in BENCH_NOTES.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_common import setup_backend  # noqa: E402
+
+
+def _time(fn, *args, iters=200):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    use_cpu = setup_backend()
+    import jax
+    import jax.numpy as jnp
+
+    from evotorch_tpu.ops.ranking import fused_centered_rank
+    from evotorch_tpu.ops.sampling import sample_symmetric_gaussian
+    from evotorch_tpu.tools.ranking import centered_xla
+
+    backend = "cpu" if use_cpu else jax.default_backend()
+    key = jax.random.key(0)
+
+    for n in (256, 512, 1024, 2048):
+        fit = jax.random.normal(key, (n,))
+        xla = jax.jit(lambda x: centered_xla(x, higher_is_better=True))
+        t_xla = _time(xla, fit)
+        if backend == "tpu":
+            fused = jax.jit(
+                lambda x: fused_centered_rank(x, higher_is_better=True, use_pallas=True)
+            )
+            t_fused = _time(fused, fit)
+        else:
+            t_fused = None
+        print(
+            json.dumps(
+                {
+                    "metric": "fused_centered_rank_us",
+                    "n": n,
+                    "xla_us": round(t_xla * 1e6, 2),
+                    "pallas_us": None if t_fused is None else round(t_fused * 1e6, 2),
+                    "speedup": None if t_fused is None else round(t_xla / t_fused, 3),
+                    "backend": backend,
+                }
+            )
+        )
+
+    if backend == "tpu":
+        for popsize, length in ((10_000, 12_305), (1_024, 66_048)):
+            mu = jnp.zeros(length)
+            sigma = jnp.full(length, 0.1)
+            t_xla = _time(
+                jax.jit(
+                    lambda k: sample_symmetric_gaussian(
+                        k, mu, sigma, popsize, use_pallas=False
+                    )
+                ),
+                key,
+                iters=20,
+            )
+            t_fused = _time(
+                jax.jit(
+                    lambda k: sample_symmetric_gaussian(
+                        k, mu, sigma, popsize, use_pallas=True
+                    )
+                ),
+                key,
+                iters=20,
+            )
+            print(
+                json.dumps(
+                    {
+                        "metric": "fused_antithetic_sampling_ms",
+                        "popsize": popsize,
+                        "solution_length": length,
+                        "xla_ms": round(t_xla * 1e3, 3),
+                        "pallas_ms": round(t_fused * 1e3, 3),
+                        "speedup": round(t_xla / t_fused, 3),
+                        "backend": backend,
+                    }
+                )
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -377,6 +377,31 @@ def _make_class_functional_sample(cls):
     return functional_sample
 
 
+def _use_fused_sampling() -> bool:
+    """Opt-in dispatch of antithetic sampling to the fused on-chip-PRNG
+    kernel (``ops/sampling.py``). Off by default: the kernel draws from a
+    different random stream than XLA's threefry, so enabling it changes
+    sampled values (not just speed); set ``EVOTORCH_TPU_FUSED_SAMPLING=1``
+    after micro-benching (``bench_ops.py``) shows a win on your shapes.
+    TPU only — the on-chip PRNG primitives have no lowering elsewhere, so on
+    other backends the flag warns once and the XLA path runs."""
+    import os
+
+    if os.environ.get("EVOTORCH_TPU_FUSED_SAMPLING", "0") != "1":
+        return False
+    if jax.default_backend() == "tpu":
+        return True
+    import warnings
+
+    warnings.warn(
+        "EVOTORCH_TPU_FUSED_SAMPLING=1 ignored: the fused sampling kernel's "
+        f"on-chip PRNG only lowers on TPU (current backend: "
+        f"{jax.default_backend()}); using the XLA sampler",
+        stacklevel=3,
+    )
+    return False
+
+
 class SymmetricSeparableGaussian(SeparableGaussian):
     """Antithetic separable Gaussian, the PGPE default
     (reference ``distributions.py:616-773``)."""
@@ -391,6 +416,16 @@ class SymmetricSeparableGaussian(SeparableGaussian):
             )
         mu = parameters["mu"]
         sigma = parameters["sigma"]
+        if _use_fused_sampling():
+            # opt-in fused TPU kernel (ops/sampling.py): on-chip PRNG +
+            # scale/antithetic blocks in VMEM. Distribution-equivalent but a
+            # DIFFERENT random stream than the XLA threefry path — hence
+            # opt-in via EVOTORCH_TPU_FUSED_SAMPLING=1, never a silent swap
+            from .ops.sampling import sample_symmetric_gaussian
+
+            return sample_symmetric_gaussian(
+                key, mu, sigma, num_solutions, use_pallas=True
+            )
         num_directions = num_solutions // 2
         eps = jax.random.normal(key, (num_directions, mu.shape[-1]), dtype=mu.dtype) * sigma
         # interleaved [mu+e0, mu-e0, mu+e1, mu-e1, ...]

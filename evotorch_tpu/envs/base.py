@@ -74,6 +74,17 @@ class Env:
             return int(self.action_space.n)
         return int(self.action_space.shape[0])
 
+    def batch_shard_spec(self, axis_name: str):
+        """``PartitionSpec`` pytree (or prefix) describing how a *batched*
+        state of this env shards its lane axis over ``axis_name`` — used by
+        the sharded lane-compacting runner, whose loop carry crosses
+        ``shard_map`` boundaries between chunks. The default covers the
+        ``vmap`` path (lane-leading leaves); batched-native envs with other
+        layouts (e.g. the batch-trailing rigid-body states) override it."""
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(axis_name)
+
     def reset(self, key) -> Tuple[EnvState, jnp.ndarray]:
         raise NotImplementedError
 

@@ -223,6 +223,17 @@ class RigidBodyLocomotionEnv(Env):
         obs_state = jax.tree_util.tree_map(lambda x: x[..., idx], state.obs_state)
         return EnvState(obs_state=obs_state, t=state.t[idx], key=state.key[idx])
 
+    def batch_shard_spec(self, axis_name: str):
+        """The body state is batch-trailing ``(nb, dim, B)`` — shard its LAST
+        axis; ``t``/``key`` are batch-leading."""
+        from jax.sharding import PartitionSpec as P
+
+        return EnvState(
+            obs_state=P(None, None, axis_name),
+            t=P(axis_name),
+            key=P(axis_name),
+        )
+
     # -- single-instance API: the B=1 special case ---------------------------
     @staticmethod
     def _key_as_batch(key) -> jnp.ndarray:

@@ -38,6 +38,7 @@ __all__ = [
     "reset_tensors",
     "run_vectorized_rollout",
     "run_vectorized_rollout_compacting",
+    "run_vectorized_rollout_compacting_sharded",
     "RolloutResult",
 ]
 
@@ -785,3 +786,320 @@ def run_vectorized_rollout_compacting(
         total_steps=carry.total_steps,
         total_episodes=total_episodes,
     )
+
+
+# ----------------------- sharded lane-compacting runner -----------------------
+# The episodes contract on a device mesh (VERDICT r3 #5): the jitted chunk /
+# compact / finalize building blocks above are shard_mapped over a "pop"
+# axis, while the host loop — the compaction decision — stays outside,
+# exactly as in the single-device runner. The loop carry crosses shard_map
+# boundaries between chunks, so it must have a consistent sharded global
+# form: per-lane leaves shard over the mesh; per-shard "scalars" (stats,
+# key, step counters — which genuinely DIVERGE between shards) get a leading
+# shard axis so their global form is a (n_shards, ...) stack. Widths are
+# per-shard and uniform across shards (SPMD: one trace), so the compaction
+# decision reads the MAX active count over shards.
+
+
+def _expand_shard_scalars(carry: "RolloutCarry") -> "RolloutCarry":
+    """Give the per-shard scalar leaves a leading length-1 axis (the local
+    view of a (n_shards, ...) global stack)."""
+    ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)  # noqa: E731
+    return carry._replace(
+        stats=ex(carry.stats),
+        key=carry.key[None],
+        total_steps=carry.total_steps[None],
+        t_global=carry.t_global[None],
+    )
+
+
+def _squeeze_shard_scalars(carry: "RolloutCarry") -> "RolloutCarry":
+    sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)  # noqa: E731
+    return carry._replace(
+        stats=sq(carry.stats),
+        key=carry.key[0],
+        total_steps=carry.total_steps[0],
+        t_global=carry.t_global[0],
+    )
+
+
+def _sharded_carry_specs(env, axis_name: str) -> "RolloutCarry":
+    from jax.sharding import PartitionSpec as P
+
+    lane = P(axis_name)
+    env_spec = (
+        env.batch_shard_spec(axis_name)
+        if getattr(env, "batched_native", False)
+        else lane
+    )
+    # stats/key/counters carry the leading shard axis (see expand above)
+    return RolloutCarry(
+        env_states=env_spec,
+        obs=lane,
+        policy_states=lane,
+        scores=lane,
+        episodes_done=lane,
+        steps_in_episode=lane,
+        active=lane,
+        stats=lane,
+        key=lane,
+        total_steps=lane,
+        t_global=lane,
+    )
+
+
+def _params_shard_spec(lowrank: bool, axis_name: str):
+    from jax.sharding import PartitionSpec as P
+
+    if lowrank:
+        # coefficients shard; the shared center/basis replicate
+        return LowRankParamsBatch(center=P(), basis=P(), coeffs=P(axis_name))
+    return P(axis_name)
+
+
+@functools.lru_cache(maxsize=None)
+def _compacting_sharded_fns(
+    env,
+    policy: FlatParamsPolicy,
+    num_episodes: int,
+    max_t: int,
+    hard_cap: int,
+    observation_normalization: bool,
+    alive_bonus_schedule,
+    decrease_rewards_by,
+    action_noise_stdev,
+    compute_dtype,
+    mesh,
+    axis_name: str,
+    lowrank: bool,
+):
+    from jax.sharding import PartitionSpec as P
+
+    init_fn, chunk_fn, compact_fn, finalize_fn = _compacting_fns(
+        env,
+        policy,
+        num_episodes,
+        max_t,
+        hard_cap,
+        observation_normalization,
+        alive_bonus_schedule,
+        decrease_rewards_by,
+        action_noise_stdev,
+        compute_dtype,
+    )
+    carry_specs = _sharded_carry_specs(env, axis_name)
+    params_spec = _params_shard_spec(lowrank, axis_name)
+    lane = P(axis_name)
+
+    def sh_init_local(params_shard, key, stats):
+        my_key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+        carry, params_cast = init_fn(params_shard, my_key, stats)
+        n_local = carry.active.shape[0]
+        lane_ids = jnp.arange(n_local, dtype=jnp.int32)  # LOCAL ids per shard
+        scores_buf = jnp.zeros(n_local, dtype=jnp.float32)
+        eps_buf = jnp.zeros(n_local, dtype=jnp.int32)
+        return _expand_shard_scalars(carry), params_cast, lane_ids, scores_buf, eps_buf
+
+    sh_init = jax.jit(
+        jax.shard_map(
+            sh_init_local,
+            mesh=mesh,
+            in_specs=(params_spec, P(), P()),
+            out_specs=(carry_specs, params_spec, lane, lane, lane),
+            check_vma=False,
+        )
+    )
+
+    chunk_cache: dict = {}
+
+    def sh_chunk(params, carry, num_steps: int):
+        fn = chunk_cache.get(num_steps)
+        if fn is None:
+
+            def local(params_shard, carry):
+                c, count = chunk_fn(params_shard, _squeeze_shard_scalars(carry), num_steps)
+                return _expand_shard_scalars(c), count[None]
+
+            fn = jax.jit(
+                jax.shard_map(
+                    local,
+                    mesh=mesh,
+                    in_specs=(params_spec, carry_specs),
+                    out_specs=(carry_specs, lane),
+                    check_vma=False,
+                )
+            )
+            chunk_cache[num_steps] = fn
+        return fn(params, carry)
+
+    compact_cache: dict = {}
+
+    def sh_compact(carry, params, lane_ids, scores_buf, eps_buf, new_width: int):
+        fn = compact_cache.get(new_width)
+        if fn is None:
+
+            def local(carry, params_shard, lane_ids, scores_buf, eps_buf):
+                c, p, ids, sb, eb = compact_fn(
+                    _squeeze_shard_scalars(carry),
+                    params_shard,
+                    lane_ids,
+                    scores_buf,
+                    eps_buf,
+                    new_width,
+                )
+                return _expand_shard_scalars(c), p, ids, sb, eb
+
+            fn = jax.jit(
+                jax.shard_map(
+                    local,
+                    mesh=mesh,
+                    in_specs=(carry_specs, params_spec, lane, lane, lane),
+                    out_specs=(carry_specs, params_spec, lane, lane, lane),
+                    check_vma=False,
+                )
+            )
+            compact_cache[new_width] = fn
+        return fn(carry, params, lane_ids, scores_buf, eps_buf)
+
+    def sh_finalize_local(carry, lane_ids, scores_buf, eps_buf, stats0):
+        c = _squeeze_shard_scalars(carry)
+        mean_scores, eps_total_local = finalize_fn(c, lane_ids, scores_buf, eps_buf)
+        # merge per-shard obs-norm stat deltas with a psum (the collective
+        # form of the reference's actor delta-sync, gymne.py:524-573)
+        delta = jax.tree_util.tree_map(lambda new, old: new - old, c.stats, stats0)
+        merged = jax.tree_util.tree_map(
+            lambda old, d: old + jax.lax.psum(d, axis_name), stats0, delta
+        )
+        return (
+            mean_scores,
+            merged,
+            jax.lax.psum(c.total_steps, axis_name),
+            jax.lax.psum(eps_total_local, axis_name),
+            # per-shard COUNTED interactions (total_steps sums active lanes
+            # only, so it is invariant under compaction — compaction saves
+            # wall-clock on dead lanes, not counted steps)
+            c.total_steps[None],
+        )
+
+    sh_finalize = jax.jit(
+        jax.shard_map(
+            sh_finalize_local,
+            mesh=mesh,
+            in_specs=(carry_specs, lane, lane, lane, P()),
+            out_specs=(lane, P(), P(), P(), lane),
+            check_vma=False,
+        )
+    )
+
+    return sh_init, sh_chunk, sh_compact, sh_finalize
+
+
+def run_vectorized_rollout_compacting_sharded(
+    env,
+    policy: FlatParamsPolicy,
+    params_batch,
+    key,
+    stats: CollectedStats,
+    *,
+    mesh,
+    axis_name: str = "pop",
+    num_episodes: int = 1,
+    episode_length: Optional[int] = None,
+    observation_normalization: bool = False,
+    alive_bonus_schedule: Optional[tuple] = None,
+    decrease_rewards_by: Optional[float] = None,
+    action_noise_stdev: Optional[float] = None,
+    compute_dtype=None,
+    chunk_size: int = 25,
+    min_width: Optional[int] = None,
+    allowed_widths: Optional[tuple] = None,
+    return_per_shard_steps: bool = False,
+) -> RolloutResult:
+    """``run_vectorized_rollout_compacting`` with the population sharded over
+    ``mesh[axis_name]``: each device narrows ITS working set as its lanes
+    finish, so the episodes contract stops paying for dead lanes on every
+    shard — the single-device runner's win, preserved on the hardware the
+    framework targets (VERDICT r3 #5).
+
+    ``allowed_widths``/``min_width`` are PER-SHARD widths; the width descent
+    is uniform across shards (one SPMD trace per width), driven by the MAX
+    per-shard active count so no shard overflows. Scores/stats/counters are
+    exactly those of ``eval_mode="episodes"`` up to the per-shard RNG fold
+    (each shard folds ``axis_index`` into the key, like ``evaluate_sharded``).
+
+    Not traceable (it syncs lane counts to the host between chunks); call it
+    from host code. Returns a :class:`RolloutResult` whose ``stats`` are the
+    psum-merged statistics and whose counters are mesh-global."""
+    n = _params_popsize(params_batch)
+    n_shards = int(mesh.shape[axis_name])
+    if n % n_shards != 0:
+        raise ValueError(f"Population size {n} must divide the mesh axis {n_shards}")
+    n_local = n // n_shards
+    max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
+    if episode_length is not None:
+        max_t = min(max_t, int(episode_length))
+    hard_cap = max_t * int(num_episodes) + 1
+
+    sh_init, sh_chunk, sh_compact, sh_finalize = _compacting_sharded_fns(
+        env,
+        policy,
+        int(num_episodes),
+        max_t,
+        hard_cap,
+        bool(observation_normalization),
+        alive_bonus_schedule,
+        decrease_rewards_by,
+        action_noise_stdev,
+        compute_dtype,
+        mesh,
+        str(axis_name),
+        isinstance(params_batch, LowRankParamsBatch),
+    )
+
+    if allowed_widths is None:
+        if min_width is None:
+            min_width = max(256, _pow2_at_least(max(1, n_local // 16)))
+        widths = []
+        w = _pow2_at_least(min_width)
+        while w <= n_local // 2:
+            widths.append(w)
+            w *= 2
+        allowed_widths = tuple(sorted(widths))
+    else:
+        allowed_widths = tuple(sorted(int(w) for w in allowed_widths if w < n_local))
+
+    stats0 = stats
+    carry, params, lane_ids, scores_buf, eps_buf = sh_init(params_batch, key, stats)
+
+    max_chunks = -(-hard_cap // int(chunk_size)) + 1
+    prev_counts = None
+    for _ in range(max_chunks):
+        carry, counts = sh_chunk(params, carry, int(chunk_size))
+        if prev_counts is not None:
+            # pipelined one chunk behind, like the single-device runner: the
+            # chunk just dispatched keeps all shards busy during this host
+            # round-trip. The decision uses the MAX shard count so the new
+            # width fits every shard.
+            n_active = int(jnp.max(prev_counts))
+            if n_active == 0:
+                break
+            width = carry.active.shape[0] // n_shards
+            lower = [w for w in allowed_widths if w < width]
+            if lower and n_active <= max(lower):
+                carry, params, lane_ids, scores_buf, eps_buf = sh_compact(
+                    carry, params, lane_ids, scores_buf, eps_buf, max(lower)
+                )
+        prev_counts = counts
+
+    mean_scores, merged_stats, total_steps, total_episodes, per_shard = sh_finalize(
+        carry, lane_ids, scores_buf, eps_buf, stats0
+    )
+    result = RolloutResult(
+        scores=mean_scores,
+        stats=merged_stats,
+        total_steps=total_steps,
+        total_episodes=total_episodes,
+    )
+    if return_per_shard_steps:
+        return result, per_shard
+    return result

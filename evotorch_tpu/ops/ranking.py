@@ -15,7 +15,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..tools.ranking import centered as _xla_centered
+from ..tools.ranking import centered_xla as _xla_centered
 
 __all__ = ["fused_centered_rank"]
 
@@ -26,12 +26,19 @@ def _pallas_kernel(fit_ref, out_ref):
     # rank of each element = number of strictly-smaller elements plus the
     # number of equal elements appearing earlier (stable tie-break), computed
     # as one O(n^2) comparison block living entirely in VMEM — beats the
-    # double argsort's three HBM round-trips for mid-sized populations
+    # double argsort's three HBM round-trips for mid-sized populations.
+    # NaNs order LAST (argsort semantics: jnp.argsort places NaN at the end),
+    # so a NaN fitness ranks "best" exactly as in the XLA path — the total
+    # order is lexicographic on (isnan, value, index)
     col = fit[:, None]
     row = fit[None, :]
+    col_nan = jnp.isnan(col)
+    row_nan = jnp.isnan(row)
     idx = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
     jdx = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
-    smaller = (row < col) | ((row == col) & (jdx < idx))
+    value_smaller = (row < col) | (~row_nan & col_nan)  # non-NaN < NaN
+    equal = (row == col) | (row_nan & col_nan)  # NaN == NaN for the tie-break
+    smaller = value_smaller | (equal & (jdx < idx))
     ranks = jnp.sum(smaller.astype(jnp.float32), axis=-1)
     out_ref[:] = ranks / (n - 1) - 0.5
 
@@ -50,6 +57,10 @@ def fused_centered_rank(
         return _xla_centered(x, higher_is_better=higher_is_better)
 
     from jax.experimental import pallas as pl
+
+    # no Mosaic lowering off-TPU: interpret there (tests; the tools/ranking
+    # dispatcher only auto-selects this path on TPU anyway)
+    interpret = interpret or jax.default_backend() != "tpu"
 
     if x.shape[-1] == 1:
         # degenerate population: match the XLA fallback (zeros, no 0/0)

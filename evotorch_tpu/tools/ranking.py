@@ -9,6 +9,7 @@ better**, regardless of the objective sense of the raw fitnesses.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict
 
 import jax.numpy as jnp
@@ -37,14 +38,48 @@ def _float_dtype_like(x: jnp.ndarray):
     return x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
 
 
-def centered(fitnesses: jnp.ndarray, *, higher_is_better: bool = True) -> jnp.ndarray:
-    """Centered ranks in ``[-0.5, +0.5]`` (reference ``ranking.py:24``)."""
+def _use_fused_centered(n: int) -> bool:
+    """Dispatch ``centered`` to the fused Pallas kernel (``ops/ranking.py``)?
+    Auto: on TPU, for populations whose O(n^2) comparison block fits VMEM —
+    the regime where one fused kernel beats the double argsort's HBM
+    round-trips (micro-bench: ``bench_ops.py``). Override with
+    ``EVOTORCH_TPU_FUSED_RANK=0`` (never) / ``=1`` (any backend, any n that
+    fits). Read at trace time: jitted callers bake the decision into their
+    compiled executable."""
+    flag = os.environ.get("EVOTORCH_TPU_FUSED_RANK", "auto")
+    if flag == "0":
+        return False
+    # 1024^2 * (4B f32 + 1B bool + 8B iotas) comparison block stays well
+    # inside the ~16 MB/core VMEM budget; 2048 would already exceed it
+    if not 2 <= n <= 1024:
+        return False
+    if flag == "1":
+        return True
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def centered_xla(fitnesses: jnp.ndarray, *, higher_is_better: bool = True) -> jnp.ndarray:
+    """The plain double-argsort implementation of :func:`centered` — the
+    non-dispatching form the fused kernel falls back to."""
     x = fitnesses if higher_is_better else -fitnesses
     n = x.shape[-1]
     ranks = _ascending_ranks(x).astype(_float_dtype_like(jnp.asarray(fitnesses)))
     if n == 1:
         return jnp.zeros_like(ranks)
     return ranks / (n - 1) - 0.5
+
+
+def centered(fitnesses: jnp.ndarray, *, higher_is_better: bool = True) -> jnp.ndarray:
+    """Centered ranks in ``[-0.5, +0.5]`` (reference ``ranking.py:24``)."""
+    if _use_fused_centered(jnp.asarray(fitnesses).shape[-1]):
+        from ..ops.ranking import fused_centered_rank
+
+        return fused_centered_rank(
+            jnp.asarray(fitnesses), higher_is_better=higher_is_better, use_pallas=True
+        )
+    return centered_xla(fitnesses, higher_is_better=higher_is_better)
 
 
 def linear(fitnesses: jnp.ndarray, *, higher_is_better: bool = True) -> jnp.ndarray:

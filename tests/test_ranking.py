@@ -70,3 +70,78 @@ def test_ties_get_distinct_ranks():
     u = ranking.centered(f, higher_is_better=True)
     assert np.isclose(float(jnp.sum(u)), 0.0, atol=1e-6)
     assert len(set(np.asarray(u).tolist())) == 3
+
+
+def test_centered_dispatches_to_fused_kernel(monkeypatch):
+    # EVOTORCH_TPU_FUSED_RANK=1 forces the fused path on any backend
+    # (interpret-mode off-TPU); results must be identical to the XLA form,
+    # through the public rank() entry the algorithms actually call
+    import numpy as np
+
+    from evotorch_tpu.tools.ranking import centered_xla, rank
+
+    fit = jnp.asarray(np.random.default_rng(0).normal(size=257), jnp.float32)
+    monkeypatch.setenv("EVOTORCH_TPU_FUSED_RANK", "1")
+    got = rank(fit, "centered", higher_is_better=True)
+    monkeypatch.setenv("EVOTORCH_TPU_FUSED_RANK", "0")
+    want = rank(fit, "centered", higher_is_better=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(want), np.asarray(centered_xla(fit, higher_is_better=True)), atol=0
+    )
+
+
+def test_centered_fused_dispatch_bounds(monkeypatch):
+    # outside [2, 2048] the dispatcher must stay on XLA even when forced
+    import numpy as np
+
+    from evotorch_tpu.tools import ranking as ranking_mod
+
+    monkeypatch.setenv("EVOTORCH_TPU_FUSED_RANK", "1")
+    assert not ranking_mod._use_fused_centered(1)
+    assert not ranking_mod._use_fused_centered(4096)
+    assert not ranking_mod._use_fused_centered(2048)  # over the VMEM budget
+    assert ranking_mod._use_fused_centered(1024)
+    monkeypatch.setenv("EVOTORCH_TPU_FUSED_RANK", "0")
+    assert not ranking_mod._use_fused_centered(512)
+    # big-n always works through the public entry regardless of the flag
+    monkeypatch.setenv("EVOTORCH_TPU_FUSED_RANK", "1")
+    fit = jnp.asarray(np.random.default_rng(1).normal(size=5000), jnp.float32)
+    out = ranking_mod.rank(fit, "centered", higher_is_better=False)
+    assert out.shape == (5000,)
+
+
+def test_fused_rank_nan_semantics_match_xla():
+    # a NaN fitness (diverged rollout) must rank identically in both paths:
+    # argsort places NaN last, i.e. "best" — the fused kernel's total order
+    # is lexicographic on (isnan, value, index)
+    import numpy as np
+
+    from evotorch_tpu.ops.ranking import fused_centered_rank
+    from evotorch_tpu.tools.ranking import centered_xla
+
+    fit = jnp.asarray([1.0, jnp.nan, 3.0, 2.0, jnp.nan, -1.0], jnp.float32)
+    for hib in (True, False):
+        got = fused_centered_rank(fit, higher_is_better=hib, use_pallas=True, interpret=True)
+        want = centered_xla(fit, higher_is_better=hib)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_fused_sampling_optin_dispatch(monkeypatch):
+    # EVOTORCH_TPU_FUSED_SAMPLING is opt-in; the dispatcher must be OFF by
+    # default (the kernel changes the random stream, not just the speed)
+    import jax
+    import pytest
+
+    from evotorch_tpu.distributions import _use_fused_sampling
+
+    monkeypatch.delenv("EVOTORCH_TPU_FUSED_SAMPLING", raising=False)
+    assert not _use_fused_sampling()
+    monkeypatch.setenv("EVOTORCH_TPU_FUSED_SAMPLING", "1")
+    if jax.default_backend() == "tpu":
+        assert _use_fused_sampling()
+    else:
+        # the on-chip PRNG only lowers on TPU: elsewhere the flag must warn
+        # and fall back to the XLA sampler instead of crashing the first ask
+        with pytest.warns(UserWarning, match="only lowers on TPU"):
+            assert not _use_fused_sampling()
