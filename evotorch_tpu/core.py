@@ -146,6 +146,13 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
     device arrays by a jitted merge and only materialized (device->host) when
     a status entry is actually read — the OO hot loop therefore runs without
     per-generation host syncs (VERDICT r1 "what's weak" #3).
+
+    ``num_actors`` with a non-traceable objective spawns a host worker pool
+    whose evaluations are bounded by a **per-piece inactivity timeout of
+    1800 s by default** (a hung worker raises instead of deadlocking the
+    generation; the clock resets on every completed piece). Evaluations
+    whose single pieces legitimately exceed 30 minutes should construct
+    ``parallel.hostpool.HostEvaluatorPool`` with a larger/None ``timeout``.
     """
 
     def __init__(

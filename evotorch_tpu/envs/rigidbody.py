@@ -266,6 +266,36 @@ def _bquat_integrate(q: jnp.ndarray, omega_world: jnp.ndarray, h) -> jnp.ndarray
     return q_new / jnp.sqrt(jnp.sum(q_new * q_new, axis=-2, keepdims=True))
 
 
+def _bquat_to_mat(q: jnp.ndarray) -> jnp.ndarray:
+    """Rotation matrices ``(..., 3, 3, B)`` from quaternions ``(..., 4, B)``.
+
+    The substep rotates ~8 vectors per body quat (joint anchors, relative
+    angular velocities, torques, contact offsets, the body-frame angular
+    update): building the matrix once (~20 flops) and applying it at 15
+    flops/vector halves the rotation arithmetic vs the 30-flop quat-rotate
+    formula — the substep is VPU-flop/fusion bound (BENCH_NOTES.md
+    utilization analysis), so this is a direct attack on the dominant cost."""
+    w, x, y, z = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+    xx, yy, zz = x * x, y * y, z * z
+    xy, xz, yz = x * y, x * z, y * z
+    wx, wy, wz = w * x, w * y, w * z
+    one = jnp.ones_like(w)
+    r0 = jnp.stack((one - 2 * (yy + zz), 2 * (xy - wz), 2 * (xz + wy)), axis=-2)
+    r1 = jnp.stack((2 * (xy + wz), one - 2 * (xx + zz), 2 * (yz - wx)), axis=-2)
+    r2 = jnp.stack((2 * (xz - wy), 2 * (yz + wx), one - 2 * (xx + yy)), axis=-2)
+    return jnp.stack((r0, r1, r2), axis=-3)
+
+
+def _bmat_rotate(R: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Apply ``(..., 3, 3, B)`` rotation matrices to ``(..., 3, B)`` vectors."""
+    return jnp.sum(R * v[..., None, :, :], axis=-2)
+
+
+def _bmat_rotate_inv(R: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Apply the transposed (inverse) rotations."""
+    return jnp.sum(R * v[..., :, None, :], axis=-3)
+
+
 def _one_hot(idx: np.ndarray, n: int, dtype) -> jnp.ndarray:
     """Static selection matrix (len(idx), n); body scatters become matmuls."""
     return jnp.asarray(np.eye(n, dtype=np.float32)[np.asarray(idx)], dtype=dtype)
@@ -278,19 +308,21 @@ def _scatter_bodies(hot: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("jb,jkB->bkB", hot, v)
 
 
-def _joint_forces_batched(sys: System, st: BodyState, actions: jnp.ndarray):
+def _joint_forces_batched(sys: System, st: BodyState, actions: jnp.ndarray, R: jnp.ndarray):
     """Per-joint constraint + limit + actuation wrenches for a whole
-    population: state arrays ``(nb, comp, B)``, actions ``(num_act, B)``.
+    population: state arrays ``(nb, comp, B)``, actions ``(num_act, B)``,
+    ``R`` the per-body rotation matrices (built once per substep).
     Returns force/torque accumulators ``(nb, 3, B)``."""
     p, c = sys.joint_parent, sys.joint_child
     pq, cq = st.quat[p], st.quat[c]  # (nj, 4, B) — static row gathers
+    Rp, Rc = R[p], R[c]
     pp, cp = st.pos[p], st.pos[c]
     pv, cv = st.vel[p], st.vel[c]
     pw, cw = st.ang[p], st.ang[c]
 
     # --- positional constraint: pull the two anchor points together
-    ra = _bquat_rotate(pq, sys.anchor_p[:, :, None])  # world lever arms
-    rb = _bquat_rotate(cq, sys.anchor_c[:, :, None])
+    ra = _bmat_rotate(Rp, sys.anchor_p[:, :, None])  # world lever arms
+    rb = _bmat_rotate(Rc, sys.anchor_c[:, :, None])
     err = (cp + rb) - (pp + ra)
     verr = (cv + _bcross(cw, rb)) - (pv + _bcross(pw, ra))
     fj = -sys.pos_k[:, None, None] * err - sys.pos_c[:, None, None] * verr
@@ -308,7 +340,7 @@ def _joint_forces_batched(sys: System, st: BodyState, actions: jnp.ndarray):
     # --- angular: relative rotation decomposed onto the joint axes
     q_rel = _bquat_mul(_bquat_conj(pq), cq)
     phi = _bquat_to_rotvec(q_rel)  # (nj, 3, B), parent frame
-    w_rel = _bquat_rotate_inv(pq, cw - pw)
+    w_rel = _bmat_rotate_inv(Rp, cw - pw)
 
     # components along the (orthonormal) joint axes; since the axes form a
     # complete basis, the whole angular response is expressed per component,
@@ -348,17 +380,17 @@ def _joint_forces_batched(sys: System, st: BodyState, actions: jnp.ndarray):
     )
     tau_j = jnp.einsum("jak,jaB->jkB", sys.axes, comp_torque)
 
-    tau_w = _bquat_rotate(pq, tau_j)  # parent frame -> world
+    tau_w = _bmat_rotate(Rp, tau_j)  # parent frame -> world
     tau = tau + _scatter_bodies(inc, tau_w)
     return f, tau
 
 
-def _contact_forces_batched(sys: System, st: BodyState):
+def _contact_forces_batched(sys: System, st: BodyState, R: jnp.ndarray):
     """Sphere-vs-ground penalty contacts with clamped viscous friction,
     population-batched (``(ns, 3, B)`` intermediates)."""
     b = sys.sph_body
     dtype = st.pos.dtype
-    r_off = _bquat_rotate(st.quat[b], sys.sph_offset[:, :, None])
+    r_off = _bmat_rotate(R[b], sys.sph_offset[:, :, None])
     pen = sys.sph_radius[:, None] - (st.pos[b][..., 2, :] + r_off[..., 2, :])
     in_contact = pen > 0.0
 
@@ -389,8 +421,11 @@ def physics_substep_batched(
 ) -> BodyState:
     """One semi-implicit Euler substep for a population: ``st`` arrays are
     ``(nb, comp, B)``, ``actions`` ``(num_act, B)``."""
-    fj, tj = _joint_forces_batched(sys, st, actions)
-    fc, tc = _contact_forces_batched(sys, st)
+    # per-body rotation matrices, built ONCE and shared by every rotation in
+    # the substep (joints, contacts, body-frame angular update)
+    R = _bquat_to_mat(st.quat)
+    fj, tj = _joint_forces_batched(sys, st, actions, R)
+    fc, tc = _contact_forces_batched(sys, st, R)
     mass = sys.mass[:, None, None]
     f = fj + fc + mass * sys.gravity[None, :, None]
     tau = tj + tc
@@ -398,10 +433,10 @@ def physics_substep_batched(
     vel = st.vel + h * f / mass
     # angular update in the body frame, where the inertia tensor is diagonal
     inertia = sys.inertia[:, :, None]
-    w_body = _bquat_rotate_inv(st.quat, st.ang)
-    tau_body = _bquat_rotate_inv(st.quat, tau)
+    w_body = _bmat_rotate_inv(R, st.ang)
+    tau_body = _bmat_rotate_inv(R, tau)
     w_body = w_body + h * (tau_body - _bcross(w_body, inertia * w_body)) / inertia
-    ang = _bquat_rotate(st.quat, w_body)
+    ang = _bmat_rotate(R, w_body)
 
     # stability clamps: cap velocities so stiff-spring transients cannot blow up
     vel = jnp.clip(vel, -sys.max_vel, sys.max_vel)

@@ -294,7 +294,16 @@ def _rollout_init(
     return carry, params_batch
 
 
-@functools.lru_cache(maxsize=None)
+# Bounded caches (ADVICE r3): these are keyed on env/policy INSTANCES, so an
+# unbounded cache would pin every env/policy ever used (plus their jitted
+# closures) for the process lifetime — and unlike jit caches they are not
+# freed by jax.clear_caches(). 64 entries comfortably covers the handful of
+# long-lived env/policy/config combos a training process realistically holds;
+# eviction merely costs a retrace on the next use of an evicted combo.
+_ENGINE_CACHE_SIZE = 64
+
+
+@functools.lru_cache(maxsize=_ENGINE_CACHE_SIZE)
 def _make_step(
     env,
     policy: FlatParamsPolicy,
@@ -559,7 +568,7 @@ def _pow2_at_least(x: int) -> int:
     return p
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=_ENGINE_CACHE_SIZE)
 def _compacting_fns(
     env,
     policy: FlatParamsPolicy,
@@ -857,7 +866,7 @@ def _params_shard_spec(lowrank: bool, axis_name: str):
     return P(axis_name)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=_ENGINE_CACHE_SIZE)
 def _compacting_sharded_fns(
     env,
     policy: FlatParamsPolicy,
@@ -1013,6 +1022,7 @@ def run_vectorized_rollout_compacting_sharded(
     chunk_size: int = 25,
     min_width: Optional[int] = None,
     allowed_widths: Optional[tuple] = None,
+    prewarm: bool = False,
     return_per_shard_steps: bool = False,
 ) -> RolloutResult:
     """``run_vectorized_rollout_compacting`` with the population sharded over
@@ -1070,6 +1080,20 @@ def run_vectorized_rollout_compacting_sharded(
 
     stats0 = stats
     carry, params, lane_ids, scores_buf, eps_buf = sh_init(params_batch, key, stats)
+
+    if prewarm:
+        # compile the whole width-descent chain on throwaway copies of the
+        # initial state, so a deeper compaction in a later generation never
+        # drops a trace+compile into someone's timing loop (mirrors the
+        # single-device runner's prewarm)
+        c, p, ids, sb, eb = carry, params, lane_ids, scores_buf, eps_buf
+        c, _ = sh_chunk(p, c, int(chunk_size))
+        sh_finalize(c, ids, sb, eb, stats0)
+        for w in sorted(allowed_widths, reverse=True):
+            c, p, ids, sb, eb = sh_compact(c, p, ids, sb, eb, w)
+            c, _ = sh_chunk(p, c, int(chunk_size))
+            sh_finalize(c, ids, sb, eb, stats0)
+        jax.block_until_ready(c.scores)
 
     max_chunks = -(-hard_cap // int(chunk_size)) + 1
     prev_counts = None

@@ -80,7 +80,15 @@ class VecNE(NEProblem):
         # repacked out of the working set between chunks — see
         # net/vecrl.py:run_vectorized_rollout_compacting); "budget" = fixed
         # interaction budget with auto-reset — the throughput-optimal contract
-        # where every computed step is a counted interaction
+        # where every computed step is a counted interaction.
+        #
+        # Reproducibility caveat (user-facing): with num_episodes == 1 and no
+        # action_noise_stdev, "episodes_compact" scores are BIT-IDENTICAL to
+        # "episodes". With multi-episode evaluation or action noise the
+        # per-step RNG fan-out depends on the working width, so compacted
+        # scores are distribution-equivalent but not bit-reproducible against
+        # the monolithic runner (and sharded evaluation folds a per-shard
+        # key, which likewise changes realized randomness at any width).
         if eval_mode not in ("episodes", "episodes_compact", "budget"):
             raise ValueError(
                 "eval_mode must be 'episodes', 'episodes_compact' or 'budget',"

@@ -2,6 +2,10 @@
 
 import argparse
 import os
+import sys
+
+# run from anywhere: the package lives one directory up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def setup_platform():

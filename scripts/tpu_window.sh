@@ -1,0 +1,53 @@
+#!/bin/bash
+# Run the full round-4 TPU measurement battery at the first healthy tunnel
+# window. Each step appends JSON lines to bench_curves/tpu_r4/*.log so a
+# tunnel drop mid-battery loses only the step in flight. Order = VERDICT r4
+# priority: contracts table first, then lowrank MXU proof, then kernels,
+# then learning curves.
+set -u
+cd "$(dirname "$0")/.."
+OUT=bench_curves/tpu_r4
+mkdir -p "$OUT"
+
+probe() {
+  timeout 40 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+}
+
+run() { # name, command...
+  local name=$1; shift
+  echo "=== $name: $* ===" | tee -a "$OUT/battery.log"
+  ( "$@" 2>>"$OUT/$name.stderr" | tee -a "$OUT/$name.log" ) \
+    && echo "=== $name OK ===" | tee -a "$OUT/battery.log" \
+    || echo "=== $name FAILED ($?) ===" | tee -a "$OUT/battery.log"
+}
+
+if ! probe; then
+  echo "TPU tunnel unhealthy; aborting" >&2
+  exit 1
+fi
+
+# 1. the three-contract table, f32 then bf16 (same config as BENCH_NOTES r2b)
+run bench_f32 python bench.py
+run bench_bf16 env BENCH_BF16=1 python bench.py
+
+# 2. the MXU claim: wide policy dense vs low-rank (budget contract isolates
+#    the policy cost; episodes_compact shows the combined effect)
+run wide_dense env BENCH_HIDDEN=256,256 BENCH_BF16=1 python bench.py
+run wide_lowrank env BENCH_HIDDEN=256,256 BENCH_BF16=1 BENCH_LOWRANK=32 python bench.py
+
+# 3. fused-kernel micro-bench (justifies/revokes the dispatch defaults)
+run bench_ops python bench_ops.py
+
+# 4. sharded bench on the single real chip (mesh of 1; exercise the path)
+run bench_multichip python bench_multichip.py
+
+# 5. learning evidence: HalfCheetah (no alive bonus) 200 gens at popsize 10k,
+#    then Humanoid 100 gens with the velocity term reported separately
+run curve_halfcheetah python examples/locomotion_curve.py --env halfcheetah \
+  --popsize 10000 --generations 200 --episode-length 250 --eval-every 10 \
+  --bf16 --out "$OUT/halfcheetah_tpu.jsonl"
+run curve_humanoid python examples/locomotion_curve.py --env humanoid \
+  --popsize 10000 --generations 100 --episode-length 200 --eval-every 5 \
+  --bf16 --out "$OUT/humanoid_tpu.jsonl"
+
+echo "battery complete" | tee -a "$OUT/battery.log"
