@@ -24,7 +24,13 @@ import sys
 import time
 from functools import partial
 
-from bench_common import bench_config, build_policy, fresh_pgpe_state, setup_backend
+from bench_common import (
+    bench_config,
+    build_policy,
+    compact_kwargs,
+    fresh_pgpe_state,
+    setup_backend,
+)
 
 
 def main():
@@ -82,12 +88,14 @@ def main():
         if mode == "episodes_compact":
             ask_jit = jax.jit(partial(ask, popsize=popsize))
             tell_jit = jax.jit(tell)
+            ckw = compact_kwargs(cfg)
 
             def gen(state, key, prewarm=False):
                 k1, k2 = jax.random.split(key)
                 values = ask_jit(k1, state)
                 result = run_vectorized_rollout_compacting(
-                    env, policy, values, k2, stats, prewarm=prewarm, **rollout_kwargs
+                    env, policy, values, k2, stats, prewarm=prewarm,
+                    **ckw, **rollout_kwargs,
                 )
                 state = tell_jit(state, values, result.scores)
                 return state, result.total_steps, result.scores

@@ -64,7 +64,26 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
         "lowrank": int(os.environ.get("BENCH_LOWRANK", "0")),
         "env_name": os.environ.get("BENCH_ENV", "humanoid"),
         "env_kwargs": json.loads(os.environ.get("BENCH_ENV_ARGS", "{}")),
+        # lane-compaction tuning (episodes_compact only): chunk size between
+        # host width-decisions, and the width-menu floor — the knobs to sweep
+        # on real hardware (BENCH_NOTES.md)
+        "compact_chunk": int(os.environ.get("BENCH_COMPACT_CHUNK", "25")),
+        "compact_min_width": (
+            int(os.environ["BENCH_COMPACT_MINWIDTH"])
+            if "BENCH_COMPACT_MINWIDTH" in os.environ
+            else None
+        ),
     }
+
+
+def compact_kwargs(cfg: dict, *, n_shards: int = 1) -> dict:
+    """Lane-compaction runner kwargs from the BENCH knobs — one place for
+    both benches. Width knobs are GLOBAL; pass ``n_shards`` to translate for
+    the per-shard sharded runner."""
+    kwargs = {"chunk_size": cfg["compact_chunk"]}
+    if cfg["compact_min_width"] is not None:
+        kwargs["min_width"] = max(1, cfg["compact_min_width"] // n_shards)
+    return kwargs
 
 
 def build_policy(env):

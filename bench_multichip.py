@@ -23,7 +23,13 @@ import os
 import sys
 import time
 
-from bench_common import bench_config, build_policy, fresh_pgpe_state, setup_backend
+from bench_common import (
+    bench_config,
+    build_policy,
+    compact_kwargs,
+    fresh_pgpe_state,
+    setup_backend,
+)
 
 
 def main():
@@ -116,6 +122,7 @@ def main():
         tell_jit = jax.jit(pgpe_tell)
 
         first_gen = [True]
+        ckw = compact_kwargs(cfg, n_shards=mesh_size)
 
         def generation(state, key, stats):
             k1, k2 = jax.random.split(key)
@@ -126,6 +133,7 @@ def main():
                 num_episodes=1,
                 episode_length=episode_length,
                 compute_dtype=compute_dtype,
+                **ckw,
                 # compile the full width-descent chain during the warmup
                 # generation so no compile lands in the timed loop
                 prewarm=first_gen[0],

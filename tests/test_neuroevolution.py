@@ -442,3 +442,53 @@ def test_vecne_episodes_compact_eval_mode():
     np.testing.assert_allclose(
         np.asarray(batch2.evals[:, 0]), scores, rtol=1e-5, atol=1e-5
     )
+
+
+def test_vecne_compact_config_knobs():
+    # compaction tuning knobs change scheduling, never scores (num_episodes=1)
+    import numpy as np
+
+    from evotorch_tpu.core import SolutionBatch
+    from evotorch_tpu.neuroevolution import VecNE
+
+    def make(cfg=None, **kw):
+        return VecNE(
+            "cartpole",
+            "Linear(obs_length, 8) >> Tanh() >> Linear(8, act_length)",
+            env_config={"continuous_actions": True},
+            episode_length=40,
+            eval_mode="episodes_compact",
+            compact_config=cfg,
+            seed=3,
+            **kw,
+        )
+
+    rng = np.random.default_rng(11)
+    p_default = make()
+    values = jnp.asarray(rng.normal(size=(16, p_default.solution_length)) * 0.3, jnp.float32)
+    p_tuned = make({"chunk_size": 7, "allowed_widths": (2, 4), "prewarm": True})
+    b1 = SolutionBatch(p_default, values=values)
+    b2 = SolutionBatch(p_tuned, values=values)
+    p_default.evaluate(b1)
+    p_tuned.evaluate(b2)
+    np.testing.assert_allclose(
+        np.asarray(b1.evals_of(0)), np.asarray(b2.evals_of(0)), atol=1e-5
+    )
+
+    # the SHARDED path translates the same (global-width) config per shard:
+    # same scores as the unsharded default-config evaluation of a sharded
+    # problem, and the kwargs must actually reach the sharded runner
+    p_sharded = make(
+        {"chunk_size": 7, "allowed_widths": (4, 8), "prewarm": True}, num_actors=2
+    )
+    b3 = SolutionBatch(p_sharded, values=values)
+    p_sharded.evaluate(b3)  # resolves num_actors -> 2-shard mesh
+    p_sharded_default = make(num_actors=2)
+    b4 = SolutionBatch(p_sharded_default, values=values)
+    p_sharded_default.evaluate(b4)
+    np.testing.assert_allclose(
+        np.asarray(b3.evals_of(0)), np.asarray(b4.evals_of(0)), atol=1e-5
+    )
+
+    with pytest.raises(ValueError, match="compact_config"):
+        make({"chunk": 5})
