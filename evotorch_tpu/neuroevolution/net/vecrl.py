@@ -700,11 +700,13 @@ def run_vectorized_rollout_compacting(
       matters on tunneled TPU links).
     - The working width starts at N and descends through a small fixed menu
       (``allowed_widths``, default: the powers of two in
-      ``[max(256, pow2(N/16)), N/2]`` — at most 4 entries for the default
-      ``min_width``), at most one menu step per chunk — so the set of XLA
-      compilations is exactly the chain of adjacent width pairs, which
-      ``prewarm=True`` compiles up front (so a later, deeper compaction never
-      drops a compile into someone's timing loop).
+      ``[max(256, pow2(N/64)), N/2]``), jumping straight to the TIGHTEST
+      width that holds the survivors — skewed death-time distributions kill
+      most of the population in the first chunks, and stepping one notch per
+      chunk would pay several more chunks at wide widths. The expensive
+      compilations (the stepping program per width) are bounded by the menu
+      size and prewarmed by ``prewarm=True``; a jump adds only a cheap
+      (from, to) gather trace.
     - Results are scattered into full-width device buffers keyed by original
       lane id, so scores come back in the caller's order with no host-side
       bookkeeping.
@@ -740,7 +742,11 @@ def run_vectorized_rollout_compacting(
 
     if allowed_widths is None:
         if min_width is None:
-            min_width = max(256, _pow2_at_least(max(1, n // 16)))
+            # floor 256 (one full lane tile's worth of sublane batches):
+            # deeper menus than the r3 n/16 floor — with the compile set
+            # bounded to the descent pairs (prewarmable), the tail of a
+            # skewed-death population is worth tracking tightly
+            min_width = max(256, _pow2_at_least(max(1, n // 64)))
         widths = []
         w = _pow2_at_least(min_width)
         while w <= n // 2:
@@ -756,16 +762,27 @@ def run_vectorized_rollout_compacting(
     eps_buf = jnp.zeros(n, dtype=jnp.int32)
 
     if prewarm:
-        # compile the whole descent chain (chunk + finalize at every width,
-        # every adjacent compact pair) on throwaway copies of the initial state
-        c, p, ids, sb, eb = carry, params, lane_ids, scores_buf, eps_buf
-        c, _ = chunk_fn(p, c, int(chunk_size))
-        finalize_fn(c, ids, sb, eb)
+        # compile chunk + finalize at every width and EVERY (from, to)
+        # compact pair a runtime jump can hit — the jump policy's first real
+        # compaction is typically full-width -> min_width directly, so the
+        # adjacent chain alone would leave that trace in the timing loop.
+        # O(k^2) tiny gather traces + k stepping programs, on throwaway
+        # copies of the initial state
+        c0, _ = chunk_fn(params, carry, int(chunk_size))
+        finalize_fn(c0, lane_ids, scores_buf, eps_buf)
+        states = {c0.active.shape[0]: (c0, params, lane_ids, scores_buf, eps_buf)}
         for w in sorted(allowed_widths, reverse=True):
-            c, p, ids, sb, eb = compact_fn(c, p, ids, sb, eb, w)
+            narrowed = None
+            for fw in sorted(states, reverse=True):
+                if fw > w:
+                    narrowed = compact_fn(*states[fw], w)
+            if narrowed is None:
+                continue
+            c, p, ids, sb, eb = narrowed
             c, _ = chunk_fn(p, c, int(chunk_size))
             finalize_fn(c, ids, sb, eb)
-        jax.block_until_ready(c.scores)
+            states[w] = (c, p, ids, sb, eb)
+        jax.block_until_ready(jax.tree_util.tree_leaves(states)[0])
 
     max_chunks = -(-hard_cap // int(chunk_size)) + 1
     prev_count = None
@@ -779,12 +796,16 @@ def run_vectorized_rollout_compacting(
             if n_active == 0:
                 break
             width = carry.active.shape[0]
-            # descend at most one menu step per chunk: compilation work is
-            # bounded to the chain of adjacent width pairs
-            lower = [w for w in allowed_widths if w < width]
-            if lower and n_active <= max(lower):
+            # jump straight to the TIGHTEST allowed width that holds the
+            # survivors: with skewed death-time distributions most of the
+            # population dies in the first chunks, and stepping the menu one
+            # notch per chunk would pay several more chunks at wide widths.
+            # The expensive compile (chunk_fn) is still one per width;
+            # jumping only adds cheap (from, to) gather traces
+            fits = [w for w in allowed_widths if w < width and n_active <= w]
+            if fits:
                 carry, params, lane_ids, scores_buf, eps_buf = compact_fn(
-                    carry, params, lane_ids, scores_buf, eps_buf, max(lower)
+                    carry, params, lane_ids, scores_buf, eps_buf, min(fits)
                 )
         prev_count = count
 
@@ -1068,7 +1089,8 @@ def run_vectorized_rollout_compacting_sharded(
 
     if allowed_widths is None:
         if min_width is None:
-            min_width = max(256, _pow2_at_least(max(1, n_local // 16)))
+            # same deeper default floor as the single-device runner
+            min_width = max(256, _pow2_at_least(max(1, n_local // 64)))
         widths = []
         w = _pow2_at_least(min_width)
         while w <= n_local // 2:
@@ -1082,18 +1104,26 @@ def run_vectorized_rollout_compacting_sharded(
     carry, params, lane_ids, scores_buf, eps_buf = sh_init(params_batch, key, stats)
 
     if prewarm:
-        # compile the whole width-descent chain on throwaway copies of the
-        # initial state, so a deeper compaction in a later generation never
-        # drops a trace+compile into someone's timing loop (mirrors the
-        # single-device runner's prewarm)
-        c, p, ids, sb, eb = carry, params, lane_ids, scores_buf, eps_buf
-        c, _ = sh_chunk(p, c, int(chunk_size))
-        sh_finalize(c, ids, sb, eb, stats0)
+        # compile chunk + finalize at every width and every (from, to)
+        # compact pair a runtime jump can hit (mirrors the single-device
+        # prewarm), so no trace+compile lands in a timing loop
+        c0, _ = sh_chunk(params, carry, int(chunk_size))
+        sh_finalize(c0, lane_ids, scores_buf, eps_buf, stats0)
+        states = {
+            c0.active.shape[0] // n_shards: (c0, params, lane_ids, scores_buf, eps_buf)
+        }
         for w in sorted(allowed_widths, reverse=True):
-            c, p, ids, sb, eb = sh_compact(c, p, ids, sb, eb, w)
+            narrowed = None
+            for fw in sorted(states, reverse=True):
+                if fw > w:
+                    narrowed = sh_compact(*states[fw], w)
+            if narrowed is None:
+                continue
+            c, p, ids, sb, eb = narrowed
             c, _ = sh_chunk(p, c, int(chunk_size))
             sh_finalize(c, ids, sb, eb, stats0)
-        jax.block_until_ready(c.scores)
+            states[w] = (c, p, ids, sb, eb)
+        jax.block_until_ready(jax.tree_util.tree_leaves(states)[0])
 
     max_chunks = -(-hard_cap // int(chunk_size)) + 1
     prev_counts = None
@@ -1108,10 +1138,12 @@ def run_vectorized_rollout_compacting_sharded(
             if n_active == 0:
                 break
             width = carry.active.shape[0] // n_shards
-            lower = [w for w in allowed_widths if w < width]
-            if lower and n_active <= max(lower):
+            # jump to the tightest per-shard width that holds every shard's
+            # survivors (see the single-device loop for the rationale)
+            fits = [w for w in allowed_widths if w < width and n_active <= w]
+            if fits:
                 carry, params, lane_ids, scores_buf, eps_buf = sh_compact(
-                    carry, params, lane_ids, scores_buf, eps_buf, max(lower)
+                    carry, params, lane_ids, scores_buf, eps_buf, min(fits)
                 )
         prev_counts = counts
 
