@@ -45,6 +45,7 @@ def main():
     from evotorch_tpu.envs import make_env
     from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
     from evotorch_tpu.neuroevolution.net.vecrl import (
+        global_lane_ids,
         run_vectorized_rollout,
         run_vectorized_rollout_compacting_sharded,
     )
@@ -78,16 +79,19 @@ def main():
     state = fresh_pgpe_state(policy.parameter_count)
 
     def local_rollout(values_shard, key, stats):
-        # per-shard rollout with a device-unique key; stat deltas and step
-        # counters merge across the pop axis with psums (the collective form
-        # of the reference's actor delta-sync, gymne.py:524-573)
-        my_key = jax.random.fold_in(key, jax.lax.axis_index("pop"))
+        # per-lane PRNG chains seeded by GLOBAL lane ids (same key on every
+        # shard): the sharded program's realized randomness is identical to
+        # the unsharded one. Stat deltas and step counters merge across the
+        # pop axis with psums (the collective form of the reference's actor
+        # delta-sync, gymne.py:524-573)
+        ids = global_lane_ids("pop", values_shard.shape[0])
         result = run_vectorized_rollout(
             env,
             policy,
             values_shard,
-            my_key,
+            key,
             stats,
+            lane_ids=ids,
             num_episodes=1,
             episode_length=episode_length,
             compute_dtype=compute_dtype,

@@ -39,6 +39,7 @@ __all__ = [
     "run_vectorized_rollout",
     "run_vectorized_rollout_compacting",
     "run_vectorized_rollout_compacting_sharded",
+    "global_lane_ids",
     "RolloutResult",
 ]
 
@@ -187,7 +188,9 @@ class RolloutResult(NamedTuple):
 class RolloutCarry(NamedTuple):
     """Loop state of the rollout engine. Per-lane leaves are batch-leading
     except ``env_states`` (whose layout belongs to the env; see
-    ``Env.batched_native``); ``stats``/``key``/counters are global."""
+    ``Env.batched_native``); ``key`` is the ``(n,)`` array of per-lane PRNG
+    chains (randomness is a per-lane property — see ``_rollout_init``);
+    ``stats``/counters are global."""
 
     env_states: Any
     obs: jnp.ndarray
@@ -251,13 +254,23 @@ def _rollout_init(
     *,
     observation_normalization: bool,
     compute_dtype,
+    lane_ids=None,
 ):
-    """Build the initial carry (full width) and the compute-dtype params."""
+    """Build the initial carry (full width) and the compute-dtype params.
+
+    Each lane carries its OWN PRNG chain, seeded by ``fold_in(key,
+    lane_id)`` — realized randomness is therefore a per-lane property,
+    independent of the working width (compaction), the batch composition,
+    and the mesh topology (a sharded evaluation passing global ``lane_ids``
+    reproduces the unsharded one bit-for-bit)."""
     n = _params_popsize(params_batch)
     params_batch = _params_cast(params_batch, compute_dtype)
 
-    key, sub = jax.random.split(key)
-    reset_keys = jax.random.split(sub, n)
+    if lane_ids is None:
+        lane_ids = jnp.arange(n, dtype=jnp.int32)
+    lane_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(lane_ids)
+    pair = jax.vmap(lambda k: jax.random.split(k, 2))(lane_keys)
+    lane_keys, reset_keys = pair[:, 0], pair[:, 1]
     env_states, obs = _env_reset(env, reset_keys)
     if observation_normalization:
         # the initial reset observations are fed to the policy at t=0, so
@@ -287,7 +300,7 @@ def _rollout_init(
         steps_in_episode=jnp.zeros(n, dtype=jnp.int32),
         active=jnp.ones(n, dtype=bool),
         stats=stats,
-        key=key,
+        key=lane_keys,  # (n,) per-lane PRNG chains
         total_steps=jnp.zeros((), dtype=jnp.int32),
         t_global=jnp.zeros((), dtype=jnp.int32),
     )
@@ -332,7 +345,14 @@ def _make_step(
 
     def step(params_batch, ctx, c: RolloutCarry) -> RolloutCarry:
         n = c.active.shape[0]
-        key, noise_key, reset_key = jax.random.split(c.key, 3)
+        # advance each lane's own PRNG chain (only when this config consumes
+        # randomness — otherwise the chains stay untouched and XLA drops the
+        # splits entirely)
+        if auto_reset or action_noise_stdev is not None:
+            triple = jax.vmap(lambda k: jax.random.split(k, 3))(c.key)
+            lane_keys, noise_keys, reset_keys = triple[:, 0], triple[:, 1], triple[:, 2]
+        else:
+            lane_keys, noise_keys, reset_keys = c.key, None, None
 
         policy_in = (
             stats_normalize(c.stats, c.obs) if observation_normalization else c.obs
@@ -347,7 +367,11 @@ def _make_step(
 
         noise = None
         if action_noise_stdev is not None:
-            noise = action_noise_stdev * jax.random.normal(noise_key, raw.shape)
+            # per-lane noise from each lane's own chain: the draw is
+            # independent of the working width / batch composition
+            noise = action_noise_stdev * jax.vmap(
+                lambda k: jax.random.normal(k, raw.shape[1:])
+            )(noise_keys)
         actions = _policy_to_action(raw, env.action_space, noise, clip=True)
 
         if getattr(env, "batched_native", False):
@@ -383,8 +407,8 @@ def _make_step(
             return jnp.where(m, new, old)
 
         if auto_reset:
-            # auto-reset the envs that finished an episode
-            reset_keys = jax.random.split(reset_key, n)
+            # auto-reset the envs that finished an episode (reset keys come
+            # from the per-lane chains: width-independent)
             fresh_states, fresh_obs = _env_reset(env, reset_keys)
             env_states_next = _env_state_select(
                 env, finished, fresh_states, new_env_states
@@ -429,7 +453,7 @@ def _make_step(
             steps_in_episode=steps_in_episode,
             active=active,
             stats=new_stats,
-            key=key,
+            key=lane_keys,
             total_steps=total_steps,
             t_global=c.t_global + 1,
         )
@@ -467,8 +491,19 @@ def run_vectorized_rollout(
     action_noise_stdev: Optional[float] = None,
     compute_dtype=None,
     eval_mode: str = "episodes",
+    lane_ids=None,
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
+
+    Randomness is a PER-LANE property: lane ``i``'s PRNG chain is seeded by
+    ``fold_in(key, lane_ids[i])`` (default ``lane_ids = arange(N)``) and
+    advances with that lane, so realized randomness does not depend on the
+    working width, the batch composition, or the mesh topology. A sharded
+    caller passing each shard's GLOBAL lane ids (and the same ``key``)
+    reproduces the unsharded evaluation bit-for-bit — except under online
+    observation normalization, where each lane is normalized by its
+    cohort's running statistics and sharding changes the cohort (cohort
+    semantics, like the reference's per-actor stats).
 
     The logic mirrors ``VecGymNE._evaluate_subbatch``
     (``vecgymne.py:744-916``): one sub-environment per solution, lockstep
@@ -516,6 +551,7 @@ def run_vectorized_rollout(
         stats,
         observation_normalization=observation_normalization,
         compute_dtype=compute_dtype,
+        lane_ids=lane_ids,
     )
     step = _make_step(
         env,
@@ -597,7 +633,7 @@ def _compacting_fns(
     )
 
     @jax.jit
-    def init_fn(params_batch, key, stats):
+    def init_fn(params_batch, key, stats, lane_ids=None):
         return _rollout_init(
             env,
             policy,
@@ -606,6 +642,7 @@ def _compacting_fns(
             stats,
             observation_normalization=observation_normalization,
             compute_dtype=compute_dtype,
+            lane_ids=lane_ids,
         )
 
     @partial(jax.jit, static_argnames=("num_steps",))
@@ -644,7 +681,7 @@ def _compacting_fns(
             steps_in_episode=carry.steps_in_episode[sel],
             active=carry.active[sel],
             stats=carry.stats,
-            key=carry.key,
+            key=carry.key[sel],  # per-lane chains travel with their lanes
             total_steps=carry.total_steps,
             t_global=carry.t_global,
         )
@@ -711,12 +748,13 @@ def run_vectorized_rollout_compacting(
       lane id, so scores come back in the caller's order with no host-side
       bookkeeping.
 
-    With ``num_episodes == 1`` (the benchmark configuration) the scores are
-    numerically identical to the monolithic runner's: compaction reorders
-    lanes but every lane's dynamics, policy and reward stream are per-lane
-    deterministic. (With ``num_episodes > 1`` or ``action_noise_stdev`` the
-    per-step RNG fan-out depends on the working width, so individual scores
-    differ in distribution-equivalent ways.)
+    Scores are numerically identical to the monolithic runner's in every
+    configuration — multi-episode, action noise: randomness is a per-lane
+    property (each lane carries its own PRNG chain, gathered along with its
+    state on compaction — ``_rollout_init``), so compaction reorders lanes
+    without touching any lane's dynamics, noise or resets. (With
+    observation normalization the masked stat reductions cover the same
+    lane set at every width, so scores agree up to float summation order.)
 
     Not traceable (it syncs lane counts to the host); use the monolithic
     runner inside jit/shard_map.
@@ -833,11 +871,11 @@ def run_vectorized_rollout_compacting(
 
 def _expand_shard_scalars(carry: "RolloutCarry") -> "RolloutCarry":
     """Give the per-shard scalar leaves a leading length-1 axis (the local
-    view of a (n_shards, ...) global stack)."""
+    view of a (n_shards, ...) global stack). ``key`` is per-lane state and
+    needs no expansion."""
     ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)  # noqa: E731
     return carry._replace(
         stats=ex(carry.stats),
-        key=carry.key[None],
         total_steps=carry.total_steps[None],
         t_global=carry.t_global[None],
     )
@@ -847,7 +885,6 @@ def _squeeze_shard_scalars(carry: "RolloutCarry") -> "RolloutCarry":
     sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)  # noqa: E731
     return carry._replace(
         stats=sq(carry.stats),
-        key=carry.key[0],
         total_steps=carry.total_steps[0],
         t_global=carry.t_global[0],
     )
@@ -862,7 +899,8 @@ def _sharded_carry_specs(env, axis_name: str) -> "RolloutCarry":
         if getattr(env, "batched_native", False)
         else lane
     )
-    # stats/key/counters carry the leading shard axis (see expand above)
+    # stats/counters carry the leading shard axis (see expand above); key is
+    # the per-lane chain array, a lane leaf like scores
     return RolloutCarry(
         env_states=env_spec,
         obs=lane,
@@ -876,6 +914,15 @@ def _sharded_carry_specs(env, axis_name: str) -> "RolloutCarry":
         total_steps=lane,
         t_global=lane,
     )
+
+
+def global_lane_ids(axis_name: str, n_local: int) -> jnp.ndarray:
+    """This shard's GLOBAL lane indices (inside ``shard_map``): the seeding
+    contract of the per-lane PRNG chains — every sharded caller must derive
+    ids exactly this way (rank * n_local + local index) for sharded
+    evaluation to reproduce the unsharded one."""
+    rank = jax.lax.axis_index(axis_name)
+    return rank * n_local + jnp.arange(n_local, dtype=jnp.int32)
 
 
 def _params_shard_spec(lowrank: bool, axis_name: str):
@@ -922,10 +969,14 @@ def _compacting_sharded_fns(
     lane = P(axis_name)
 
     def sh_init_local(params_shard, key, stats):
-        my_key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
-        carry, params_cast = init_fn(params_shard, my_key, stats)
-        n_local = carry.active.shape[0]
-        lane_ids = jnp.arange(n_local, dtype=jnp.int32)  # LOCAL ids per shard
+        # GLOBAL lane ids seed the per-lane PRNG chains (same key on every
+        # shard): the sharded evaluation reproduces the unsharded one,
+        # whatever the topology
+        n_local = _params_popsize(params_shard)
+        carry, params_cast = init_fn(
+            params_shard, key, stats, global_lane_ids(axis_name, n_local)
+        )
+        lane_ids = jnp.arange(n_local, dtype=jnp.int32)  # LOCAL buffer ids
         scores_buf = jnp.zeros(n_local, dtype=jnp.float32)
         eps_buf = jnp.zeros(n_local, dtype=jnp.int32)
         return _expand_shard_scalars(carry), params_cast, lane_ids, scores_buf, eps_buf
@@ -1054,9 +1105,14 @@ def run_vectorized_rollout_compacting_sharded(
 
     ``allowed_widths``/``min_width`` are PER-SHARD widths; the width descent
     is uniform across shards (one SPMD trace per width), driven by the MAX
-    per-shard active count so no shard overflows. Scores/stats/counters are
-    exactly those of ``eval_mode="episodes"`` up to the per-shard RNG fold
-    (each shard folds ``axis_index`` into the key, like ``evaluate_sharded``).
+    per-shard active count so no shard overflows. Per-lane PRNG chains are
+    seeded by GLOBAL lane ids with the same base key on every shard, so
+    without observation normalization scores/counters are BIT-IDENTICAL to
+    the unsharded ``eval_mode="episodes"`` evaluation of the same
+    population — the mesh is an execution detail. (With observation
+    normalization, each shard's lanes are normalized by their shard-local
+    running statistics mid-rollout — cohort semantics, like the reference's
+    per-actor stats — so sharded scores differ from unsharded ones.)
 
     Not traceable (it syncs lane counts to the host between chunks); call it
     from host code. Returns a :class:`RolloutResult` whose ``stats`` are the

@@ -31,6 +31,7 @@ from .net.rl import ActClipLayer
 from .net.runningnorm import RunningNorm
 from .net.vecrl import (
     _params_popsize,
+    global_lane_ids,
     run_vectorized_rollout,
     run_vectorized_rollout_compacting,
     run_vectorized_rollout_compacting_sharded,
@@ -84,13 +85,18 @@ class VecNE(NEProblem):
         # interaction budget with auto-reset — the throughput-optimal contract
         # where every computed step is a counted interaction.
         #
-        # Reproducibility caveat (user-facing): with num_episodes == 1 and no
-        # action_noise_stdev, "episodes_compact" scores are BIT-IDENTICAL to
-        # "episodes". With multi-episode evaluation or action noise the
-        # per-step RNG fan-out depends on the working width, so compacted
-        # scores are distribution-equivalent but not bit-reproducible against
-        # the monolithic runner (and sharded evaluation folds a per-shard
-        # key, which likewise changes realized randomness at any width).
+        # Reproducibility guarantee (user-facing): randomness is a PER-LANE
+        # property (each lane carries its own PRNG chain seeded by its
+        # original lane index — vecrl.py:_rollout_init), so in every config —
+        # multi-episode, action noise — "episodes_compact" scores equal
+        # "episodes" scores (bit-identical; with observation_normalization
+        # the masked stat reductions may differ in float summation order
+        # only), and WITHOUT observation normalization sharded evaluation is
+        # bit-identical to unsharded. With observation normalization on,
+        # sharding still changes scores semantically: each lane is
+        # normalized by its cohort's running statistics, and sharding
+        # changes the cohort each shard's stats see mid-rollout (deltas
+        # psum-merge only at the end, like the reference's per-actor stats).
         if eval_mode not in ("episodes", "episodes_compact", "budget"):
             raise ValueError(
                 "eval_mode must be 'episodes', 'episodes_compact' or 'budget',"
@@ -381,13 +387,16 @@ class VecNE(NEProblem):
         eval_mode = self._eval_mode
 
         def local(values_shard, key, stats):
-            my_key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+            # per-lane PRNG chains seeded by GLOBAL lane ids (same key on
+            # every shard): sharded evaluation == unsharded (bit-for-bit
+            # when observation normalization is off; see the eval_mode notes)
             result = run_vectorized_rollout(
                 self._env,
                 self._policy,
                 values_shard,
-                my_key,
+                key,
                 stats,
+                lane_ids=global_lane_ids(axis_name, _params_popsize(values_shard)),
                 num_episodes=self._num_episodes,
                 episode_length=self._episode_length,
                 observation_normalization=obsnorm,

@@ -382,13 +382,15 @@ def test_compacting_recurrent_policy_state_travels():
 
 def _sharded_monolithic_episodes(env, policy, params, key, stats, mesh, **kw):
     """The sharded episodes-mode reference: shard_map the monolithic runner
-    with the same per-shard key fold the compacting runner uses."""
+    with the same global-lane-id PRNG derivation the compacting runner uses."""
     from jax.sharding import PartitionSpec as P
 
     def local(values_shard, key, stats):
-        my_key = jax.random.fold_in(key, jax.lax.axis_index("pop"))
+        from evotorch_tpu.neuroevolution.net.vecrl import global_lane_ids
+
         r = run_vectorized_rollout(
-            env, policy, values_shard, my_key, stats, eval_mode="episodes", **kw
+            env, policy, values_shard, key, stats, eval_mode="episodes",
+            lane_ids=global_lane_ids("pop", values_shard.shape[0]), **kw
         )
         return r.scores, jax.lax.psum(r.total_steps, "pop"), jax.lax.psum(
             r.total_episodes, "pop"
@@ -524,4 +526,87 @@ def test_sharded_compacting_lowrank():
     )
     np.testing.assert_allclose(
         np.asarray(r_lr.scores), np.asarray(r_dense.scores), rtol=1e-4, atol=1e-4
+    )
+
+
+# -- per-lane PRNG chains: randomness as a per-lane property ------------------
+
+
+def test_compacting_bit_exact_with_noise_and_multi_episode():
+    # the former caveat config: multi-episode + action noise used to be only
+    # distribution-equivalent under compaction; per-lane PRNG chains make it
+    # bit-exact
+    from evotorch_tpu.neuroevolution.net.vecrl import (
+        run_vectorized_rollout_compacting,
+    )
+
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    rng = np.random.default_rng(9)
+    params = jnp.asarray(rng.normal(size=(16, policy.parameter_count)), jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    kw = dict(num_episodes=3, episode_length=40, action_noise_stdev=0.05)
+    mono = run_vectorized_rollout(
+        env, policy, params, jax.random.key(3), stats, eval_mode="episodes", **kw
+    )
+    comp = run_vectorized_rollout_compacting(
+        env, policy, params, jax.random.key(3), stats,
+        chunk_size=9, allowed_widths=(4, 8), **kw,
+    )
+    np.testing.assert_array_equal(np.asarray(comp.scores), np.asarray(mono.scores))
+    assert int(comp.total_episodes) == int(mono.total_episodes) == 48
+
+
+def test_rollout_invariant_to_batch_composition():
+    # a lane's score depends only on its parameters and its lane id — NOT on
+    # which other lanes share the batch: evaluating a subset with the same
+    # lane ids reproduces the full run's rows exactly
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    rng = np.random.default_rng(10)
+    params = jnp.asarray(rng.normal(size=(12, policy.parameter_count)), jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    kw = dict(num_episodes=2, episode_length=30, action_noise_stdev=0.1)
+    full = run_vectorized_rollout(
+        env, policy, params, jax.random.key(5), stats, eval_mode="episodes", **kw
+    )
+    idx = jnp.asarray([2, 5, 11], dtype=jnp.int32)
+    part = run_vectorized_rollout(
+        env, policy, params[idx], jax.random.key(5), stats,
+        eval_mode="episodes", lane_ids=idx, **kw,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(part.scores), np.asarray(full.scores)[np.asarray(idx)]
+    )
+
+
+def test_vecne_sharded_equals_unsharded_bit_exact():
+    # the mesh is an execution detail: same seed => identical scores whether
+    # the population is evaluated sharded (8-way) or unsharded, even with
+    # action noise and multi-episode evaluation
+    from evotorch_tpu.core import SolutionBatch
+    from evotorch_tpu.neuroevolution import VecNE
+
+    def make():
+        return VecNE(
+            "cartpole",
+            "Linear(obs_length, 8) >> Tanh() >> Linear(8, act_length)",
+            env_config={"continuous_actions": True},
+            episode_length=30,
+            num_episodes=2,
+            action_noise_stdev=0.05,
+            seed=21,
+        )
+
+    rng = np.random.default_rng(12)
+    p_plain, p_sharded = make(), make()
+    values = jnp.asarray(
+        rng.normal(size=(24, p_plain.solution_length)) * 0.3, jnp.float32
+    )
+    b1 = SolutionBatch(p_plain, values=values)
+    b2 = SolutionBatch(p_sharded, values=values)
+    p_plain.evaluate(b1)
+    p_sharded.evaluate_sharded(b2)
+    np.testing.assert_array_equal(
+        np.asarray(b1.evals_of(0)), np.asarray(b2.evals_of(0))
     )
