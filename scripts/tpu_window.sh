@@ -1,24 +1,50 @@
 #!/bin/bash
-# Run the full round-4 TPU measurement battery at the first healthy tunnel
-# window. Each step appends JSON lines to bench_curves/tpu_r4/*.log so a
-# tunnel drop mid-battery loses only the step in flight. Order = VERDICT r4
+# Run the full TPU measurement battery at the first healthy tunnel window.
+# Each step appends JSON lines to bench_curves/tpu_r5/*.log so a tunnel drop
+# mid-battery loses only the step in flight; completed steps leave a .ok
+# stamp and are skipped on re-fire, so a second transient window resumes
+# where the first one died instead of repeating it. Order = VERDICT r4
 # priority: contracts table first, then lowrank MXU proof, then kernels,
 # then learning curves.
 set -u
+set -o pipefail  # the .ok stamp is load-bearing: it must reflect the python
+                 # command's status, not tee's
 cd "$(dirname "$0")/.."
-OUT=bench_curves/tpu_r4
+OUT=bench_curves/tpu_r5
 mkdir -p "$OUT"
 
 probe() {
   timeout 40 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
 }
 
-run() { # name, command...
-  local name=$1; shift
-  echo "=== $name: $* ===" | tee -a "$OUT/battery.log"
-  ( "$@" 2>>"$OUT/$name.stderr" | tee -a "$OUT/$name.log" ) \
-    && echo "=== $name OK ===" | tee -a "$OUT/battery.log" \
-    || echo "=== $name FAILED ($?) ===" | tee -a "$OUT/battery.log"
+STEPS=()
+
+run() { # name, timeout_seconds, command...
+  # every step gets a hard timeout: if the tunnel drops between steps, a
+  # fresh python's FIRST backend use hangs forever (CLAUDE.md), which would
+  # wedge the watcher with the deadline never checked
+  local name=$1 tmo=$2; shift 2
+  STEPS+=("$name")
+  if [ -e "$OUT/$name.ok" ]; then
+    echo "=== $name already OK, skipping ===" | tee -a "$OUT/battery.log"
+    return 0
+  fi
+  echo "=== $name ($tmo s max): $* ===" | tee -a "$OUT/battery.log"
+  if ( timeout "$tmo" "$@" 2>>"$OUT/$name.stderr" | tee -a "$OUT/$name.log" ); then
+    touch "$OUT/$name.ok"
+    echo "=== $name OK ===" | tee -a "$OUT/battery.log"
+  else
+    echo "=== $name FAILED ($?) ===" | tee -a "$OUT/battery.log"
+    if ! probe; then
+      # tunnel died mid-battery: every remaining step would hang to its full
+      # timeout (a fresh python's first backend use never returns). Abort;
+      # the watcher resumes probing and the next window picks up from the
+      # first unstamped step.
+      echo "=== tunnel unhealthy after $name — aborting battery ===" \
+        | tee -a "$OUT/battery.log"
+      exit 3
+    fi
+  fi
 }
 
 if ! probe; then
@@ -27,27 +53,38 @@ if ! probe; then
 fi
 
 # 1. the three-contract table, f32 then bf16 (same config as BENCH_NOTES r2b)
-run bench_f32 python bench.py
-run bench_bf16 env BENCH_BF16=1 python bench.py
+run bench_f32 1800 python bench.py
+run bench_bf16 1800 env BENCH_BF16=1 python bench.py
 
 # 2. the MXU claim: wide policy dense vs low-rank (budget contract isolates
 #    the policy cost; episodes_compact shows the combined effect)
-run wide_dense env BENCH_HIDDEN=256,256 BENCH_BF16=1 python bench.py
-run wide_lowrank env BENCH_HIDDEN=256,256 BENCH_BF16=1 BENCH_LOWRANK=32 python bench.py
+run wide_dense 1800 env BENCH_HIDDEN=256,256 BENCH_BF16=1 python bench.py
+run wide_lowrank 1800 env BENCH_HIDDEN=256,256 BENCH_BF16=1 BENCH_LOWRANK=32 python bench.py
 
-# 3. fused-kernel micro-bench (justifies/revokes the dispatch defaults)
-run bench_ops python bench_ops.py
+# 3. fused-kernel micro-bench (justifies/revokes the opt-in flags)
+run bench_ops 1800 python bench_ops.py
 
 # 4. sharded bench on the single real chip (mesh of 1; exercise the path)
-run bench_multichip python bench_multichip.py
+run bench_multichip 1800 python bench_multichip.py
 
 # 5. learning evidence: HalfCheetah (no alive bonus) 200 gens at popsize 10k,
 #    then Humanoid 100 gens with the velocity term reported separately
-run curve_halfcheetah python examples/locomotion_curve.py --env halfcheetah \
+run curve_halfcheetah 10800 python examples/locomotion_curve.py --env halfcheetah \
   --popsize 10000 --generations 200 --episode-length 250 --eval-every 10 \
   --bf16 --out "$OUT/halfcheetah_tpu.jsonl"
-run curve_humanoid python examples/locomotion_curve.py --env humanoid \
+run curve_humanoid 10800 python examples/locomotion_curve.py --env humanoid \
   --popsize 10000 --generations 100 --episode-length 200 --eval-every 5 \
   --bf16 --out "$OUT/humanoid_tpu.jsonl"
 
-echo "battery complete" | tee -a "$OUT/battery.log"
+# every step above either .ok'd or failed; report complete only if all OK
+missing=0
+for stamp in "${STEPS[@]}"; do
+  [ -e "$OUT/$stamp.ok" ] || missing=$((missing + 1))
+done
+if [ "$missing" -eq 0 ]; then
+  echo "battery complete" | tee -a "$OUT/battery.log"
+  exit 0
+else
+  echo "battery incomplete ($missing steps not OK)" | tee -a "$OUT/battery.log"
+  exit 2
+fi
