@@ -2,12 +2,12 @@
 
 Run on the backend under test (TPU when the tunnel is healthy; the ranking
 kernel also interprets on CPU but interpret-mode timings are meaningless).
-Prints one JSON line per comparison; the dispatch policy in
-``tools/ranking.py`` (auto-fused on TPU for n <= 1024 — the VMEM-bounded
-regime) and the opt-in flag ``EVOTORCH_TPU_FUSED_SAMPLING`` are
+Prints one JSON line per comparison; the opt-in flags
+``EVOTORCH_TPU_FUSED_RANK`` (both kernels ship off by default until a chip
+win is recorded here) and ``EVOTORCH_TPU_FUSED_SAMPLING`` are
 justified/refuted by these numbers — recorded in BENCH_NOTES.md. The sweep
-times XLA beyond the fused bound for context; the fused kernel is only
-timed where the dispatch would actually select it.
+times XLA beyond the fused VMEM bound (n <= 1024) for context; the fused
+kernel is only timed inside the bound, where the flag would select it.
 """
 
 import json

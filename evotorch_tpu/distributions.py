@@ -70,7 +70,13 @@ def _split_params(parameters: dict):
 
 
 def _jitted_sample_for(cls):
-    fn = _JITTED_SAMPLE_CACHE.get(cls)
+    # keyed on the fused-sampling flag as well as the class: _sample reads
+    # EVOTORCH_TPU_FUSED_SAMPLING at trace time, so a cache hit after the env
+    # var changed would silently keep serving the stale executable
+    import os
+
+    cache_key = (cls, os.environ.get("EVOTORCH_TPU_FUSED_SAMPLING", "0"))
+    fn = _JITTED_SAMPLE_CACHE.get(cache_key)
     if fn is None:
 
         def sample(key, array_params, static_items, num_solutions):
@@ -79,7 +85,7 @@ def _jitted_sample_for(cls):
             return cls._sample(key, params, num_solutions)
 
         fn = jax.jit(sample, static_argnames=("static_items", "num_solutions"))
-        _JITTED_SAMPLE_CACHE[cls] = fn
+        _JITTED_SAMPLE_CACHE[cache_key] = fn
     return fn
 
 
@@ -98,7 +104,13 @@ def _jitted_sample_lowrank_for(cls):
 
 
 def _jitted_grads_for(cls):
-    fn = _JITTED_GRADS_CACHE.get(cls)
+    # keyed on the fused-rank flag as well as the class: rank() reads
+    # EVOTORCH_TPU_FUSED_RANK at trace time (tools/ranking.py), so a cache
+    # hit after the env var changed would silently keep the stale executable
+    import os
+
+    cache_key = (cls, os.environ.get("EVOTORCH_TPU_FUSED_RANK", "auto"))
+    fn = _JITTED_GRADS_CACHE.get(cache_key)
     if fn is None:
 
         def grads(array_params, samples, fitnesses, static_items, ranking_method, higher_is_better):
@@ -110,7 +122,7 @@ def _jitted_grads_for(cls):
         fn = jax.jit(
             grads, static_argnames=("static_items", "ranking_method", "higher_is_better")
         )
-        _JITTED_GRADS_CACHE[cls] = fn
+        _JITTED_GRADS_CACHE[cache_key] = fn
     return fn
 
 
@@ -384,7 +396,12 @@ def _use_fused_sampling() -> bool:
     sampled values (not just speed); set ``EVOTORCH_TPU_FUSED_SAMPLING=1``
     after micro-benching (``bench_ops.py``) shows a win on your shapes.
     TPU only — the on-chip PRNG primitives have no lowering elsewhere, so on
-    other backends the flag warns once and the XLA path runs."""
+    other backends the flag warns once and the XLA path runs.
+
+    Read at trace time, like ``EVOTORCH_TPU_FUSED_RANK``: the OO samplers key
+    their jit cache on the flag's value, so toggling the env var takes effect
+    on the next ``sample()``; user-jitted functional samplers bake the value
+    at their own first trace."""
     import os
 
     if os.environ.get("EVOTORCH_TPU_FUSED_SAMPLING", "0") != "1":

@@ -53,7 +53,19 @@ def fused_centered_rank(
 ) -> jnp.ndarray:
     """Centered ranks in ``[-0.5, 0.5]`` along the last axis."""
     x = jnp.asarray(fitnesses)
-    if not use_pallas:
+    if not use_pallas or x.dtype not in (
+        jnp.float32,
+        jnp.bfloat16,
+        jnp.float16,
+        jnp.int16,
+        jnp.int8,
+        jnp.uint16,
+        jnp.uint8,
+    ):
+        # the kernel ranks in f32, so only dtypes whose values embed in f32
+        # exactly may take it; f64 (and int32/int64 values >= 2^24) would
+        # collide distinct fitnesses in f32, get index tie-breaks, and
+        # diverge from centered_xla (which ranks in the input dtype)
         return _xla_centered(x, higher_is_better=higher_is_better)
 
     from jax.experimental import pallas as pl

@@ -40,24 +40,19 @@ def _float_dtype_like(x: jnp.ndarray):
 
 def _use_fused_centered(n: int) -> bool:
     """Dispatch ``centered`` to the fused Pallas kernel (``ops/ranking.py``)?
-    Auto: on TPU, for populations whose O(n^2) comparison block fits VMEM —
-    the regime where one fused kernel beats the double argsort's HBM
-    round-trips (micro-bench: ``bench_ops.py``). Override with
-    ``EVOTORCH_TPU_FUSED_RANK=0`` (never) / ``=1`` (any backend, any n that
-    fits). Read at trace time: jitted callers bake the decision into their
-    compiled executable."""
+    Default: **off** — the kernel ships opt-in until an on-chip micro-bench
+    (``bench_ops.py``, captured by ``scripts/tpu_window.sh``) records a win
+    over ``centered_xla`` at representative population sizes; an unmeasured
+    default in every TPU PGPE generation is risk with no evidence. Opt in
+    with ``EVOTORCH_TPU_FUSED_RANK=1`` (any backend, any n that fits VMEM);
+    ``=0`` pins it off. Read at trace time: jitted callers bake the decision
+    into their compiled executable."""
     flag = os.environ.get("EVOTORCH_TPU_FUSED_RANK", "auto")
-    if flag == "0":
+    if flag != "1":
         return False
     # 1024^2 * (4B f32 + 1B bool + 8B iotas) comparison block stays well
     # inside the ~16 MB/core VMEM budget; 2048 would already exceed it
-    if not 2 <= n <= 1024:
-        return False
-    if flag == "1":
-        return True
-    import jax
-
-    return jax.default_backend() == "tpu"
+    return 2 <= n <= 1024
 
 
 def centered_xla(fitnesses: jnp.ndarray, *, higher_is_better: bool = True) -> jnp.ndarray:

@@ -92,7 +92,7 @@ def test_centered_dispatches_to_fused_kernel(monkeypatch):
 
 
 def test_centered_fused_dispatch_bounds(monkeypatch):
-    # outside [2, 2048] the dispatcher must stay on XLA even when forced
+    # outside [2, 1024] the dispatcher must stay on XLA even when forced
     import numpy as np
 
     from evotorch_tpu.tools import ranking as ranking_mod
