@@ -113,21 +113,6 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         if self._lowrank_rank is not None:
             if self._lowrank_rank < 1:
                 raise ValueError(f"lowrank_rank must be >= 1, got {lowrank_rank}")
-            if distributed:
-                raise ValueError(
-                    "lowrank_rank is not available in distributed mode: the "
-                    "factored population is already the bandwidth-optimal "
-                    "representation for sharded evaluation (VecNE shards the "
-                    "coefficients); combine lowrank_rank with num_actors on "
-                    "the problem instead"
-                )
-            if num_interactions is not None:
-                raise ValueError(
-                    "lowrank_rank cannot be combined with num_interactions: "
-                    "the adaptive-popsize loop concatenates per-round batches, "
-                    "and factored batches with different bases cannot "
-                    "concatenate"
-                )
             if not hasattr(dist_cls, "_sample_lowrank"):
                 raise ValueError(
                     f"{dist_cls.__name__} has no factored sampler; "
@@ -227,10 +212,13 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         raise NotImplementedError
 
     # -------------------------------------------------------- non-distributed
-    def _sample_population(self, popsize: int) -> SolutionBatch:
+    def _sample_population(self, popsize: int, *, basis=None) -> SolutionBatch:
         if self._lowrank_rank is not None:
             samples = self._distribution.sample_lowrank(
-                popsize, self._lowrank_rank, key=self._problem.next_rng_key()
+                popsize,
+                self._lowrank_rank,
+                key=self._problem.next_rng_key(),
+                basis=basis,
             )
             return SolutionBatch(self._problem, values=samples)
         samples = self._distribution.sample(popsize, key=self._problem.next_rng_key())
@@ -238,7 +226,11 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
 
     def _fill_and_eval_pop(self):
         """Sample + evaluate, with the adaptive-popsize loop when
-        ``num_interactions`` is configured (reference ``gaussian.py:276-349``)."""
+        ``num_interactions`` is configured (reference ``gaussian.py:276-349``).
+        In factored (low-rank) mode the generation's first round draws the
+        basis and every later round samples fresh coefficients against it, so
+        the per-round batches stay concatenable (SolutionBatch.cat of
+        shared-basis factored batches)."""
         problem = self._problem
         if self._num_interactions is None:
             self._population = self._sample_population(self._popsize)
@@ -248,8 +240,11 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         batches = []
         total_popsize = 0
         prev_made = -1
+        gen_basis = None
         while True:
-            batch = self._sample_population(self._popsize)
+            batch = self._sample_population(self._popsize, basis=gen_basis)
+            if self._lowrank_rank is not None and gen_basis is None:
+                gen_basis = batch.values.basis
             problem.evaluate(batch)
             batches.append(batch)
             total_popsize += len(batch)
@@ -302,6 +297,7 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
             num_interactions=self._num_interactions,
             ranking_method=self._ranking_method if self._ranking_method is not None else "raw",
             obj_index=self._obj_index,
+            lowrank_rank=self._lowrank_rank,
         )
         grads_list = [r["gradients"] for r in results]
         nums = np.asarray([r["num_solutions"] for r in results], dtype=np.float64)

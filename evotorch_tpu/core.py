@@ -815,6 +815,7 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         obj_index: int = 0,
         ranking_method: Optional[str] = None,
         key=None,
+        lowrank_rank: Optional[int] = None,
     ) -> List[dict]:
         """Sample a population from ``distribution``, evaluate it, and return
         ES gradients (reference ``core.py:2762-3073``). The reference fans
@@ -830,9 +831,22 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         (``core.py:3156-3301`` + ``gaussian.py:199-272``): each mesh shard
         samples its own sub-population, ranks **locally**, computes local
         gradients, and a ``pmean`` replaces the main-process weighted average
-        (shards are equal-sized, so both weighting conventions coincide)."""
+        (shards are equal-sized, so both weighting conventions coincide).
+
+        With ``lowrank_rank`` the population is sampled in factored (low-rank)
+        form and gradients are computed from the factors in O(L * rank);
+        evaluation materializes the dense matrix only at boundaries that need
+        it (plain fitness functions — VecNE rolls the factors out directly).
+        In the adaptive-popsize loop every round after the first samples fresh
+        coefficients against the generation's basis, keeping the rounds
+        concatenable."""
         if key is None:
             key = self.next_rng_key()
+        if lowrank_rank is not None and not hasattr(type(distribution), "_sample_lowrank"):
+            raise ValueError(
+                f"{type(distribution).__name__} has no factored sampler; "
+                "lowrank_rank requires SymmetricSeparableGaussian"
+            )
         self._start_preparations()
         self.before_grad_hook()
 
@@ -848,6 +862,7 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
                 result = self._sharded_sample_and_compute_gradients(
                     distribution, popsize, obj_index=obj_index,
                     ranking_method=ranking_method, key=key,
+                    lowrank_rank=lowrank_rank,
                 )
             except jax.errors.JAXTypeError as e:
                 # the objective is not jax-traceable: degrade to the
@@ -867,9 +882,15 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
                     self.update_status(hook_results)
                 return [result]
 
-        def sample_and_eval(key, n):
-            samples = distribution.sample(int(n), key=key)
-            batch = SolutionBatch(self, samples.shape[0], values=samples)
+        def sample_and_eval(key, n, basis=None):
+            if lowrank_rank is not None:
+                samples = distribution.sample_lowrank(
+                    int(n), int(lowrank_rank), key=key, basis=basis
+                )
+                batch = SolutionBatch(self, values=samples)
+            else:
+                samples = distribution.sample(int(n), key=key)
+                batch = SolutionBatch(self, samples.shape[0], values=samples)
             self.evaluate(batch)
             return samples, batch.evals[:, obj_index]
 
@@ -884,12 +905,15 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
             fitness_chunks = []
             total = 0
             prev_made = -1
+            gen_basis = None
             while True:
                 key, sub = jax.random.split(key)
-                s, f = sample_and_eval(sub, popsize)
+                s, f = sample_and_eval(sub, popsize, basis=gen_basis)
+                if lowrank_rank is not None and gen_basis is None:
+                    gen_basis = s.basis  # later rounds stay concatenable
                 sample_chunks.append(s)
                 fitness_chunks.append(f)
-                total += s.shape[0]
+                total += f.shape[0]
                 if popsize_max is not None and total >= int(popsize_max):
                     break
                 made = _as_int(self.status.get("total_interaction_count", 0)) - first_count
@@ -903,7 +927,15 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
                     # would never be reached and the loop would spin forever
                     break
                 prev_made = made
-            all_samples = jnp.concatenate(sample_chunks, axis=0)
+            if lowrank_rank is not None:
+                first_chunk = sample_chunks[0]
+                all_samples = LowRankParamsBatch(
+                    center=first_chunk.center,
+                    basis=first_chunk.basis,
+                    coeffs=jnp.concatenate([c.coeffs for c in sample_chunks], axis=0),
+                )
+            else:
+                all_samples = jnp.concatenate(sample_chunks, axis=0)
             all_fitnesses = jnp.concatenate(fitness_chunks, axis=0)
 
         grads = distribution.compute_gradients(
@@ -912,9 +944,14 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
             objective_sense=self._senses[obj_index],
             ranking_method=ranking_method if ranking_method is not None else "raw",
         )
+        num_solutions = (
+            all_samples.popsize
+            if isinstance(all_samples, LowRankParamsBatch)
+            else int(all_samples.shape[0])
+        )
         result = {
             "gradients": grads,
-            "num_solutions": int(all_samples.shape[0]),
+            "num_solutions": num_solutions,
             "mean_eval": jnp.mean(all_fitnesses),  # device scalar: stays lazy
         }
         hook_results = self.after_grad_hook.accumulate_dict(result)
@@ -931,7 +968,8 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         self._sharded_grad_cache.clear()
 
     def _sharded_sample_and_compute_gradients(
-        self, distribution, popsize: int, *, obj_index: int, ranking_method, key
+        self, distribution, popsize: int, *, obj_index: int, ranking_method, key,
+        lowrank_rank: Optional[int] = None,
     ) -> dict:
         """Shard-local sampling/ranking/gradients over the eval mesh
         (reference semantics: per-actor local ranking,
@@ -952,7 +990,7 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         ranking = ranking_method if ranking_method is not None else "raw"
         sense = self._senses[obj_index]
 
-        cache_key = (dist_cls, ranking, obj_index, sense, mesh, axis)
+        cache_key = (dist_cls, ranking, obj_index, sense, mesh, axis, lowrank_rank)
         estimator = self._sharded_grad_cache.get(cache_key)
         if estimator is None:
 
@@ -971,6 +1009,7 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
                 mesh=mesh,
                 axis_name=axis,
                 with_aux=True,
+                lowrank_rank=lowrank_rank,
             )
             self._sharded_grad_cache[cache_key] = estimator
 
@@ -1082,13 +1121,42 @@ class SolutionBatch(Serializable, RecursivePrintable):
             first = batches[0]
             self._problem = first._problem
             if any(isinstance(b._values, LowRankParamsBatch) for b in batches):
-                raise TypeError(
-                    "Low-rank (factored) batches cannot be concatenated: each "
-                    "generation has its own basis, so a merged population has "
-                    "no shared factored form. Materialize first "
-                    "(batch.values.materialize()) or avoid popsize-adaptive "
-                    "modes (num_interactions) with lowrank_rank."
+                if not all(isinstance(b._values, LowRankParamsBatch) for b in batches):
+                    raise TypeError(
+                        "Cannot concatenate factored (low-rank) batches with "
+                        "dense ones; materialize the factored side first "
+                        "(batch.values.materialize())"
+                    )
+
+                def _same_array(a, b):
+                    # `is` catches the shared-per-generation-basis case with
+                    # no device sync; the value comparison is the fallback
+                    # for rebuilt-but-equal arrays (one tiny sync per cat)
+                    return a is b or (
+                        a.shape == b.shape and a.dtype == b.dtype and bool(jnp.all(a == b))
+                    )
+
+                fv = first._values
+                if not all(
+                    _same_array(b._values.center, fv.center)
+                    and _same_array(b._values.basis, fv.basis)
+                    for b in batches[1:]
+                ):
+                    raise TypeError(
+                        "Factored (low-rank) batches concatenate only when "
+                        "they share one generation's center and basis (sample "
+                        "the later rounds with sample_lowrank(..., "
+                        "basis=first_batch.values.basis)); batches drawn "
+                        "against different bases have no shared factored "
+                        "form — materialize first (batch.values.materialize())"
+                    )
+                self._values = LowRankParamsBatch(
+                    center=fv.center,
+                    basis=fv.basis,
+                    coeffs=jnp.concatenate([b._values.coeffs for b in batches], axis=0),
                 )
+                self._evdata = jnp.concatenate([b._evdata for b in batches], axis=0)
+                return
             if isinstance(first._values, ObjectArray):
                 merged = []
                 for b in batches:

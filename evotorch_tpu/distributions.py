@@ -93,11 +93,12 @@ def _jitted_sample_lowrank_for(cls):
     fn = _JITTED_SAMPLE_LOWRANK_CACHE.get(cls)
     if fn is None:
 
-        def sample(key, array_params, static_items, num_solutions, rank):
+        def sample(key, array_params, static_items, num_solutions, rank, basis=None):
             params = dict(array_params)
             params.update(dict(static_items))
-            return cls._sample_lowrank(key, params, num_solutions, rank)
+            return cls._sample_lowrank(key, params, num_solutions, rank, basis)
 
+        # basis=None and basis=<array> trace as distinct jit signatures
         fn = jax.jit(sample, static_argnames=("static_items", "num_solutions", "rank"))
         _JITTED_SAMPLE_LOWRANK_CACHE[cls] = fn
     return fn
@@ -493,10 +494,17 @@ class SymmetricSeparableGaussian(SeparableGaussian):
     # which equal the dense formulas exactly (tested in test_lowrank.py).
 
     @classmethod
-    def _sample_lowrank(cls, key, parameters, num_solutions, rank):
+    def _sample_lowrank(cls, key, parameters, num_solutions, rank, basis=None):
         """Draw a ``LowRankParamsBatch``: antithetic coefficient pairs
         interleaved ``[+z0, -z0, +z1, -z1, ...]`` (the dense sampler's
-        direction layout above), sigma folded into the basis."""
+        direction layout above), sigma folded into the basis.
+
+        With ``basis`` given, only fresh coefficients are drawn against that
+        (already sigma-folded) basis — the shared-per-generation-basis mode
+        that makes factored batches concatenable, so the adaptive-popsize
+        loop (``num_interactions``) can keep sampling rounds within one
+        generation's subspace (reference ``core.py:3239-3282`` concatenates
+        dense rounds the same way)."""
         if num_solutions % 2 != 0:
             raise ValueError(
                 f"Number of solutions sampled from {cls.__name__} must be even,"
@@ -506,24 +514,42 @@ class SymmetricSeparableGaussian(SeparableGaussian):
         sigma = parameters["sigma"]
         rank = int(rank)
         key_basis, key_coeffs = jax.random.split(key)
-        basis = jax.random.normal(key_basis, (mu.shape[-1], rank), dtype=mu.dtype) / jnp.sqrt(
-            jnp.asarray(float(rank), mu.dtype)
-        )
-        basis = sigma[..., None] * basis  # sigma folded in: delta = basis @ z
+        if basis is None:
+            basis = jax.random.normal(
+                key_basis, (mu.shape[-1], rank), dtype=mu.dtype
+            ) / jnp.sqrt(jnp.asarray(float(rank), mu.dtype))
+            basis = sigma[..., None] * basis  # sigma folded in: delta = basis @ z
+        elif basis.shape[-1] != rank:
+            # fail fast: a rank/basis mismatch would otherwise surface as an
+            # opaque dot_general shape error deep inside a jitted forward
+            raise ValueError(
+                f"basis has rank {basis.shape[-1]} but rank={rank} was requested"
+            )
         num_directions = num_solutions // 2
         z = jax.random.normal(key_coeffs, (num_directions, rank), dtype=mu.dtype)
         coeffs = jnp.stack([z, -z], axis=1).reshape(num_solutions, rank)
         return LowRankParamsBatch(center=mu, basis=basis, coeffs=coeffs)
 
-    def sample_lowrank(self, num_solutions: int, rank: int, *, key=None) -> LowRankParamsBatch:
+    def sample_lowrank(
+        self, num_solutions: int, rank: int, *, key=None, basis=None
+    ) -> LowRankParamsBatch:
         """Stateful-API counterpart of :meth:`_sample_lowrank` (jitted per
-        class like :meth:`sample`)."""
+        class like :meth:`sample`). ``basis`` reuses an existing sigma-folded
+        basis (shared-per-generation-basis mode)."""
         if key is None:
             key = self.next_rng_key()
         arrays, static = _split_params(self._parameters)
-        return _jitted_sample_lowrank_for(type(self))(
-            key, arrays, static, int(num_solutions), int(rank)
+        out = _jitted_sample_lowrank_for(type(self))(
+            key, arrays, static, int(num_solutions), int(rank), basis
         )
+        # the jitted call returns fresh output buffers even for passed-through
+        # arrays; restoring the original objects keeps SolutionBatch.cat's
+        # shared-basis check on the `is` fast path (center is always a mu
+        # passthrough; basis only when the caller supplied one)
+        out = out._replace(center=self._parameters["mu"])
+        if basis is not None:
+            out = out._replace(basis=basis)
+        return out
 
     @classmethod
     def _compute_gradients_lowrank(cls, parameters, samples: LowRankParamsBatch, weights, ranking_used) -> dict:

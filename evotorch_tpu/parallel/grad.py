@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..tools.lowrank import dense_values
 from ..tools.ranking import rank
 from .mesh import default_mesh
 
@@ -34,6 +35,7 @@ def make_sharded_grad_estimator(
     mesh: Optional[Mesh] = None,
     axis_name: str = "pop",
     with_aux: bool = False,
+    lowrank_rank: Optional[int] = None,
 ) -> Callable:
     """Build ``g(key, num_solutions, parameters) -> grads`` where the
     sample/evaluate/rank/grad pipeline runs sharded over the mesh and the
@@ -47,7 +49,13 @@ def make_sharded_grad_estimator(
     With ``with_aux=True`` the estimator returns ``(grads, aux)`` where
     ``aux["mean_eval"]`` is the population-mean fitness (the pmean of the
     shard-local means — what the reference's main process reconstructs from
-    the per-actor ``mean_eval`` entries, ``gaussian.py:246-272``)."""
+    the per-actor ``mean_eval`` entries, ``gaussian.py:246-272``).
+
+    With ``lowrank_rank`` each shard samples its own factored (low-rank)
+    sub-population — per-shard basis, the analog of per-actor independent
+    sampling — and computes its gradients from the factors in O(L * rank);
+    only the fitness evaluation materializes the dense shard-local matrix
+    (plain fitness functions consume dense rows)."""
     if mesh is None:
         mesh = default_mesh((axis_name,))
     n_shards = mesh.shape[axis_name]
@@ -63,8 +71,14 @@ def make_sharded_grad_estimator(
         def local(key, array_params):
             parameters = {**array_params, **static_params}
             my_key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
-            samples = distribution_class._sample(my_key, parameters, local_popsize)
-            fitnesses = fitness_func(samples)
+            if lowrank_rank is not None:
+                samples = distribution_class._sample_lowrank(
+                    my_key, parameters, local_popsize, lowrank_rank
+                )
+                fitnesses = fitness_func(dense_values(samples))
+            else:
+                samples = distribution_class._sample(my_key, parameters, local_popsize)
+                fitnesses = fitness_func(samples)
             weights = rank(fitnesses, ranking_method, higher_is_better=higher_is_better)
             grads = distribution_class._compute_gradients(
                 parameters, samples, weights, ranking_method

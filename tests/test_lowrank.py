@@ -400,16 +400,6 @@ def test_oo_pgpe_lowrank_validation():
             problem, popsize=16, center_learning_rate=0.3, stdev_learning_rate=0.1,
             stdev_init=0.5, symmetric=False, lowrank_rank=4,
         )
-    with pytest.raises(ValueError, match="num_interactions"):
-        PGPE(
-            problem, popsize=16, center_learning_rate=0.3, stdev_learning_rate=0.1,
-            stdev_init=0.5, num_interactions=1000, lowrank_rank=4,
-        )
-    with pytest.raises(ValueError, match="distributed"):
-        PGPE(
-            problem, popsize=16, center_learning_rate=0.3, stdev_learning_rate=0.1,
-            stdev_init=0.5, distributed=True, lowrank_rank=4,
-        )
 
 
 def test_oo_vecne_pgpe_lowrank_never_densifies(monkeypatch):
@@ -479,3 +469,215 @@ def test_vecne_evaluate_sharded_lowrank():
         np.asarray(b_lr.evals_of(0)), np.asarray(b_dense.evals_of(0)),
         rtol=1e-4, atol=1e-4,
     )
+
+
+# -------------------- lifted restrictions (VERDICT r4 #5) --------------------
+# Factored batches concatenate when they share a generation's basis, so
+# lowrank_rank composes with num_interactions/popsize_max (the reference's
+# flagship adaptive-popsize recipe, rl_clipup.py:184-191) and with
+# distributed=True.
+
+
+def test_factored_cat_shared_basis():
+    from evotorch_tpu.core import SolutionBatch
+    from evotorch_tpu.distributions import SymmetricSeparableGaussian
+
+    problem = _sphere_problem()
+    L = problem.solution_length
+    dist = SymmetricSeparableGaussian({"mu": jnp.zeros(L), "sigma": jnp.full(L, 0.5)})
+    first = dist.sample_lowrank(8, 3, key=jax.random.key(0))
+    second = dist.sample_lowrank(6, 3, key=jax.random.key(1), basis=first.basis)
+    # the shared-basis sampler reuses the basis array (no copy, no re-fold)
+    assert second.basis is first.basis
+    b1 = SolutionBatch(problem, values=first)
+    b2 = SolutionBatch(problem, values=second)
+    merged = SolutionBatch.cat([b1, b2])
+    assert isinstance(merged.values, LowRankParamsBatch)
+    assert merged.values.coeffs.shape == (14, 3)
+    np.testing.assert_allclose(
+        np.asarray(merged.values.materialize()),
+        np.vstack([np.asarray(first.materialize()), np.asarray(second.materialize())]),
+        rtol=1e-6,
+    )
+
+
+def test_factored_cat_rejects_mismatched_basis_and_mixed():
+    from evotorch_tpu.core import SolutionBatch
+    from evotorch_tpu.distributions import SymmetricSeparableGaussian
+
+    problem = _sphere_problem()
+    L = problem.solution_length
+    dist = SymmetricSeparableGaussian({"mu": jnp.zeros(L), "sigma": jnp.full(L, 0.5)})
+    a = dist.sample_lowrank(8, 3, key=jax.random.key(0))
+    b = dist.sample_lowrank(8, 3, key=jax.random.key(99))  # fresh basis
+    with pytest.raises(TypeError, match="share one generation's"):
+        SolutionBatch.cat(
+            [SolutionBatch(problem, values=a), SolutionBatch(problem, values=b)]
+        )
+    dense = SolutionBatch(problem, values=a.materialize())
+    with pytest.raises(TypeError, match="factored"):
+        SolutionBatch.cat([SolutionBatch(problem, values=a), dense])
+
+
+def test_oo_pgpe_lowrank_adaptive_popsize_vecne():
+    # the reference's flagship recipe shape (popsize -> popsize_max under an
+    # interaction budget, rl_clipup.py:184-191) running factored end-to-end:
+    # per-generation shared basis keeps the adaptive rounds concatenable
+    from evotorch_tpu.algorithms import PGPE
+    from evotorch_tpu.neuroevolution import VecNE
+
+    problem = VecNE(
+        "cartpole",
+        "Linear(obs_length, 8) >> Tanh() >> Linear(8, act_length)",
+        env_config={"continuous_actions": True},
+        episode_length=8,
+        observation_normalization=True,
+    )
+    searcher = PGPE(
+        problem,
+        popsize=8,
+        center_learning_rate=0.2,
+        stdev_learning_rate=0.1,
+        stdev_init=0.1,
+        lowrank_rank=4,
+        num_interactions=8 * 8 * 3,  # force ~3 sampling rounds per generation
+        popsize_max=64,
+    )
+    searcher.run(3)
+    pop = searcher.population
+    assert isinstance(pop.values, LowRankParamsBatch)
+    assert len(pop) > 8  # the budget actually grew the population
+    assert len(pop) <= 64
+    assert pop.values.coeffs.shape[0] == len(pop)
+    assert np.isfinite(float(searcher.status["mean_eval"]))
+    assert searcher.status["popsize"] == len(pop)
+
+
+def test_oo_pgpe_lowrank_distributed_improves_sphere():
+    # distributed=True routes through sample_and_compute_gradients; the
+    # factored path must both run and actually optimize
+    from evotorch_tpu.algorithms import PGPE
+
+    problem = _sphere_problem()
+    searcher = PGPE(
+        problem,
+        popsize=64,
+        center_learning_rate=0.5,
+        stdev_learning_rate=0.1,
+        stdev_init=0.5,
+        optimizer="adam",
+        distributed=True,
+        lowrank_rank=8,
+    )
+    searcher.run(40)
+    assert float(searcher.status["mean_eval"]) < 30.0  # from ~9*30 initially
+
+
+def test_oo_pgpe_lowrank_distributed_adaptive_vecne():
+    # distributed + num_interactions + lowrank all at once (the full
+    # reference Humanoid configuration, minus the scale)
+    from evotorch_tpu.algorithms import PGPE
+    from evotorch_tpu.neuroevolution import VecNE
+
+    problem = VecNE(
+        "cartpole",
+        "Linear(obs_length, 8) >> Tanh() >> Linear(8, act_length)",
+        env_config={"continuous_actions": True},
+        episode_length=8,
+    )
+    searcher = PGPE(
+        problem,
+        popsize=8,
+        center_learning_rate=0.2,
+        stdev_learning_rate=0.1,
+        stdev_init=0.1,
+        lowrank_rank=4,
+        distributed=True,
+        num_interactions=8 * 8 * 2,
+        popsize_max=32,
+    )
+    searcher.run(2)
+    assert np.isfinite(float(searcher.status["mean_eval"]))
+
+
+def test_sharded_grad_estimator_lowrank_matches_local_math():
+    # the sharded factored estimator on a 1-shard mesh must equal the
+    # classmethod pipeline run by hand with the same folded key
+    from evotorch_tpu.distributions import SymmetricSeparableGaussian
+    from evotorch_tpu.parallel.grad import make_sharded_grad_estimator
+    from evotorch_tpu.parallel.mesh import default_mesh
+    from evotorch_tpu.tools.ranking import rank
+
+    L, n, k = 24, 16, 4
+    params = {
+        "mu": jnp.zeros(L),
+        "sigma": jnp.full(L, 0.4),
+        "divide_mu_grad_by": "num_directions",
+        "divide_sigma_grad_by": "num_directions",
+    }
+
+    def fitness(xs):
+        return -jnp.sum(xs**2, axis=-1)
+
+    mesh = default_mesh(("pop",), devices=jax.devices()[:1])
+    est = make_sharded_grad_estimator(
+        SymmetricSeparableGaussian,
+        fitness,
+        objective_sense="max",
+        ranking_method="centered",
+        mesh=mesh,
+        axis_name="pop",
+        lowrank_rank=k,
+    )
+    key = jax.random.key(3)
+    grads = est(key, n, params)
+
+    my_key = jax.random.fold_in(key, 0)
+    samples = SymmetricSeparableGaussian._sample_lowrank(my_key, params, n, k)
+    weights = rank(fitness(samples.materialize()), "centered", higher_is_better=True)
+    want = SymmetricSeparableGaussian._compute_gradients(
+        params, samples, weights, "centered"
+    )
+    np.testing.assert_allclose(
+        np.asarray(grads["mu"]), np.asarray(want["mu"]), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(grads["sigma"]), np.asarray(want["sigma"]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_sharded_grad_estimator_lowrank_multishard_runs():
+    # 4 shards, per-shard bases (per-actor analog): grads replicate and are
+    # finite; mean_eval aux agrees with a plausible fitness scale
+    from evotorch_tpu.distributions import SymmetricSeparableGaussian
+    from evotorch_tpu.parallel.grad import make_sharded_grad_estimator
+    from evotorch_tpu.parallel.mesh import default_mesh
+
+    L, k = 24, 4
+    params = {
+        "mu": jnp.zeros(L),
+        "sigma": jnp.full(L, 0.4),
+        "divide_mu_grad_by": "num_directions",
+        "divide_sigma_grad_by": "num_directions",
+    }
+
+    def fitness(xs):
+        return -jnp.sum(xs**2, axis=-1)
+
+    mesh = default_mesh(("pop",), devices=jax.devices()[:4])
+    est = make_sharded_grad_estimator(
+        SymmetricSeparableGaussian,
+        fitness,
+        objective_sense="max",
+        ranking_method="centered",
+        mesh=mesh,
+        axis_name="pop",
+        lowrank_rank=k,
+        with_aux=True,
+    )
+    grads, aux = est(jax.random.key(5), 32, params)
+    assert grads["mu"].shape == (L,)
+    assert grads["sigma"].shape == (L,)
+    assert bool(jnp.all(jnp.isfinite(grads["mu"])))
+    assert bool(jnp.all(jnp.isfinite(grads["sigma"])))
+    assert float(aux["mean_eval"]) < 0  # -||x||^2 is negative
