@@ -205,3 +205,95 @@ def test_sharded_training_identical_across_topologies():
     np.testing.assert_allclose(center_24, center_81, atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(scores_42, scores_81, atol=1e-4, rtol=1e-4)
     np.testing.assert_allclose(scores_24, scores_81, atol=1e-4, rtol=1e-4)
+
+
+def test_sharded_lowrank_obsnorm_identical_across_topologies():
+    """VERDICT r4 #8: the two newest representations — factored (low-rank)
+    populations and observation normalization — exercised TOGETHER under
+    sharding, across pop x model mesh layouts. Under GSPMD the obs-norm
+    statistics contract over the sharded population axis, so their psum
+    GROUPING changes with the pop-shard count (8/4/2) and the last-ulp
+    differences amplify through Humanoid's chaotic dynamics — exact identity
+    holds WITHIN a topology (determinism), and closeness across topologies.
+    The factored coefficients shard over "pop"; the shared center and basis
+    replicate (the representation's intended layout: O(L*k) replicated beats
+    O(N_local*L) sharded)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from evotorch_tpu.algorithms.functional import (
+        pgpe,
+        pgpe_ask_lowrank,
+        pgpe_tell_lowrank,
+    )
+    from evotorch_tpu.envs import Humanoid
+    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+    from evotorch_tpu.neuroevolution.net.lowrank import LowRankParamsBatch
+    from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+    from evotorch_tpu.neuroevolution.net.vecrl import run_vectorized_rollout
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    env = Humanoid()
+    net = Linear(env.observation_size, 8) >> Tanh() >> Linear(8, env.action_size)
+    policy = FlatParamsPolicy(net)
+    stats = RunningNorm(env.observation_size).stats
+    popsize, rank, episode_length, generations = 8, 4, 3, 3
+
+    def train(pop_axis, model_axis):
+        mesh = Mesh(
+            np.asarray(jax.devices()[:8]).reshape(pop_axis, model_axis),
+            axis_names=("pop", "model"),
+        )
+
+        def constrain(values: LowRankParamsBatch) -> LowRankParamsBatch:
+            return LowRankParamsBatch(
+                center=jax.lax.with_sharding_constraint(
+                    values.center, NamedSharding(mesh, P())
+                ),
+                basis=jax.lax.with_sharding_constraint(
+                    values.basis, NamedSharding(mesh, P())
+                ),
+                coeffs=jax.lax.with_sharding_constraint(
+                    values.coeffs, NamedSharding(mesh, P("pop", None))
+                ),
+            )
+
+        state = pgpe(
+            center_init=jnp.zeros(policy.parameter_count, dtype=jnp.float32),
+            center_learning_rate=0.1,
+            stdev_learning_rate=0.1,
+            objective_sense="max",
+            stdev_init=0.1,
+        )
+
+        @jax.jit
+        def step(state, key):
+            k1, k2 = jax.random.split(key)
+            values = constrain(pgpe_ask_lowrank(k1, state, popsize=popsize, rank=rank))
+            result = run_vectorized_rollout(
+                env, policy, values, k2, stats,
+                num_episodes=1, episode_length=episode_length,
+                eval_mode="budget", observation_normalization=True,
+            )
+            return pgpe_tell_lowrank(state, values, result.scores), result.scores
+
+        key = jax.random.key(43)
+        for _ in range(generations):
+            key, sub = jax.random.split(key)
+            state, scores = step(state, sub)
+        return np.asarray(state.optimizer_state.center), np.asarray(scores)
+
+    center_81, scores_81 = train(8, 1)
+    center_81b, scores_81b = train(8, 1)
+    # determinism: the same topology reproduces bit-for-bit
+    np.testing.assert_array_equal(center_81b, center_81)
+    np.testing.assert_array_equal(scores_81b, scores_81)
+    # across pop-shard counts: bounded closeness (measured max |delta| was
+    # ~8e-4 after 3 generations; bound set with ~6x margin)
+    center_42, scores_42 = train(4, 2)
+    center_24, scores_24 = train(2, 4)
+    np.testing.assert_allclose(center_42, center_81, atol=5e-3)
+    np.testing.assert_allclose(center_24, center_81, atol=5e-3)
+    np.testing.assert_allclose(scores_42, scores_81, rtol=2e-2)
+    np.testing.assert_allclose(scores_24, scores_81, rtol=2e-2)
