@@ -36,10 +36,24 @@ def parse_args():
     p.add_argument("--eval-every", type=int, default=10)
     p.add_argument("--eval-episodes", type=int, default=8)
     p.add_argument("--bf16", action="store_true")
-    p.add_argument("--center-lr", type=float, default=0.06)
-    p.add_argument("--radius-init", type=float, default=0.27)
+    # ClipUp recipe (reference rl_clipup.py:110-114): lr = 0.75 * max_speed,
+    # radius_init = 15 * max_speed; pass --center-lr / --radius-init to
+    # override the derivation
     p.add_argument("--max-speed", type=float, default=0.12)
+    p.add_argument("--center-lr", type=float, default=None)
+    p.add_argument("--radius-init", type=float, default=None)
     p.add_argument("--stdev-lr", type=float, default=0.1)
+    # the flagship-recipe knobs (reference rl_clipup.py:184-206): subtract
+    # the per-step alive bonus from the SEARCH signal so standing still
+    # isn't a local optimum ("auto" = the env's own alive_bonus), and grow
+    # the population adaptively under an interaction budget
+    p.add_argument("--decrease-rewards-by", default=None,
+                   help="per-step reward decrement; 'auto' = env.alive_bonus")
+    p.add_argument("--num-interactions", type=int, default=None)
+    p.add_argument("--popsize-max", type=int, default=None)
+    p.add_argument("--lowrank-rank", type=int, default=None)
+    p.add_argument("--network", default=None,
+                   help="policy DSL; default: 2x64-tanh MLP")
     p.add_argument("--out", default=None)
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args()
@@ -62,26 +76,39 @@ def main():
 
     out_path = args.out or f"{args.env}_curve.jsonl"
     compute_dtype = jnp.bfloat16 if args.bf16 else None
+    center_lr = args.center_lr if args.center_lr is not None else 0.75 * args.max_speed
+    radius_init = args.radius_init if args.radius_init is not None else 15 * args.max_speed
+
+    decrease = args.decrease_rewards_by
+    if decrease == "auto":
+        decrease = float(getattr(make_env(args.env), "alive_bonus", 0.0)) or None
+    elif decrease is not None:
+        decrease = float(decrease)
 
     problem = VecNE(
         args.env,
-        "Linear(obs_length, 64) >> Tanh() >> Linear(64, 64) >> Tanh()"
+        args.network
+        or "Linear(obs_length, 64) >> Tanh() >> Linear(64, 64) >> Tanh()"
         " >> Linear(64, act_length)",
         observation_normalization=True,
         episode_length=args.episode_length,
         eval_mode="episodes",
         compute_dtype=compute_dtype,
+        decrease_rewards_by=decrease,
         seed=args.seed,
     )
     searcher = PGPE(
         problem,
         popsize=args.popsize,
-        center_learning_rate=args.center_lr,
+        center_learning_rate=center_lr,
         stdev_learning_rate=args.stdev_lr,
-        radius_init=args.radius_init,
+        radius_init=radius_init,
         optimizer="clipup",
         optimizer_config={"max_speed": args.max_speed},
         ranking_method="centered",
+        num_interactions=args.num_interactions,
+        popsize_max=args.popsize_max,
+        lowrank_rank=args.lowrank_rank,
     )
 
     # center-evaluation envs: the full reward, and (when the env pays an
@@ -127,6 +154,8 @@ def main():
                 "best_eval": float(searcher.status["best_eval"]),
                 "elapsed_s": round(time.time() - t_start, 1),
             }
+            if args.num_interactions is not None:
+                row["popsize"] = int(searcher.status["popsize"])
             if gen % args.eval_every == 0 or gen == args.generations:
                 center_scores = eval_center()
                 row["center_full"] = center_scores.get("full")
