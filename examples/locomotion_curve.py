@@ -139,6 +139,10 @@ def main():
                 num_episodes=1,
                 episode_length=args.episode_length,
                 eval_mode="episodes",
+                # the center trained on normalized observations and must be
+                # evaluated on them too (the stats argument is ignored
+                # without the flag); eval-time stat updates are discarded
+                observation_normalization=True,
                 compute_dtype=compute_dtype,
             )
             outs[name] = float(jnp.mean(r.scores))

@@ -64,16 +64,30 @@ run wide_lowrank 1800 env BENCH_HIDDEN=256,256 BENCH_BF16=1 BENCH_LOWRANK=32 pyt
 # 3. fused-kernel micro-bench (justifies/revokes the opt-in flags)
 run bench_ops 1800 python bench_ops.py
 
+# 3b. compaction-knob sweep: chunk_size x width-menu floor on real lane-tile
+#     economics (r4 tuned these blind on CPU; this justifies or replaces
+#     the defaults)
+run tune_compact 2400 env BENCH_BF16=1 python scripts/tune_compact.py
+
 # 4. sharded bench on the single real chip (mesh of 1; exercise the path)
 run bench_multichip 1800 python bench_multichip.py
 
 # 5. learning evidence: HalfCheetah (no alive bonus) 200 gens at popsize 10k,
 #    then Humanoid 100 gens with the velocity term reported separately
+# lr/radius pinned to the r4 values (the runner's defaults now derive from
+# --max-speed) so the r5 curve stays comparable to halfcheetah_cpu_r4
 run curve_halfcheetah 10800 python examples/locomotion_curve.py --env halfcheetah \
   --popsize 10000 --generations 200 --episode-length 250 --eval-every 10 \
+  --center-lr 0.06 --radius-init 0.27 \
   --bf16 --out "$OUT/halfcheetah_tpu.jsonl"
+# the reference's pybullet-humanoid recipe shape (rl_clipup.py:199-206):
+# tiny-traj 200 steps, popsize 10k, MLP-64, max_speed 0.15, obs-norm, and
+# the alive bonus REMOVED from the search signal (the r4 curve trained on
+# the bonus-inclusive signal and regressed — BENCH_NOTES r5)
 run curve_humanoid 10800 python examples/locomotion_curve.py --env humanoid \
   --popsize 10000 --generations 100 --episode-length 200 --eval-every 5 \
+  --decrease-rewards-by auto --max-speed 0.15 \
+  --network "Linear(obs_length, 64) >> Tanh() >> Linear(64, act_length)" \
   --bf16 --out "$OUT/humanoid_tpu.jsonl"
 
 # every step above either .ok'd or failed; report complete only if all OK
