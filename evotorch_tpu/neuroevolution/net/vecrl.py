@@ -245,6 +245,16 @@ def _env_state_take(env, states, idx):
     return jax.tree_util.tree_map(lambda x: x[idx], states)
 
 
+def _stats_psum_merge(old: CollectedStats, new: CollectedStats, axis_name: str):
+    """Every shard absorbs every shard's stat delta: the per-step form of the
+    end-of-rollout delta merge (the accumulators are linear, so delta-psum
+    composes exactly)."""
+    delta = jax.tree_util.tree_map(lambda n, o: n - o, new, old)
+    return jax.tree_util.tree_map(
+        lambda o, d: o + jax.lax.psum(d, axis_name), old, delta
+    )
+
+
 def _rollout_init(
     env,
     policy: FlatParamsPolicy,
@@ -255,6 +265,7 @@ def _rollout_init(
     observation_normalization: bool,
     compute_dtype,
     lane_ids=None,
+    stats_sync_axis=None,
 ):
     """Build the initial carry (full width) and the compute-dtype params.
 
@@ -276,7 +287,10 @@ def _rollout_init(
         # the initial reset observations are fed to the policy at t=0, so
         # they belong in the normalization statistics (the reference updates
         # stats on every observation the policy consumes)
-        stats = stats_update(stats, obs, mask=jnp.ones(n, dtype=bool))
+        new_stats = stats_update(stats, obs, mask=jnp.ones(n, dtype=bool))
+        if stats_sync_axis is not None:
+            new_stats = _stats_psum_merge(stats, new_stats, stats_sync_axis)
+        stats = new_stats
 
     policy_proto = policy.initial_state()
     if policy_proto is None:
@@ -329,10 +343,17 @@ def _make_step(
     action_noise_stdev,
     compute_dtype,
     budget_mode: bool,
+    stats_sync_axis=None,
 ):
     """One masked control step of the whole population, as a pure function
     ``step(params_batch, carry) -> carry``. Width is taken from the carry, so
     the same step serves the monolithic loop and every compacted width.
+
+    ``stats_sync_axis``: inside a ``shard_map`` over that axis, psum-merge
+    the per-step observation-statistic deltas so every shard normalizes by
+    the MESH-GLOBAL cohort — ``obs_norm_sync="step"`` semantics. The caller
+    must guarantee every shard runs the same number of steps (mesh-global
+    loop conditions), or the collective deadlocks.
 
     When no lane can ever need a mid-rollout reset (episodes mode with
     ``num_episodes == 1``), the per-step fresh ``env_reset`` — a per-lane key
@@ -443,6 +464,8 @@ def _make_step(
             if observation_normalization
             else c.stats
         )
+        if observation_normalization and stats_sync_axis is not None:
+            new_stats = _stats_psum_merge(c.stats, new_stats, stats_sync_axis)
 
         return RolloutCarry(
             env_states=env_states_next,
@@ -474,6 +497,7 @@ def _make_step(
         "action_noise_stdev",
         "compute_dtype",
         "eval_mode",
+        "stats_sync_axis",
     ),
 )
 def run_vectorized_rollout(
@@ -492,6 +516,7 @@ def run_vectorized_rollout(
     compute_dtype=None,
     eval_mode: str = "episodes",
     lane_ids=None,
+    stats_sync_axis: Optional[str] = None,
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
 
@@ -503,7 +528,11 @@ def run_vectorized_rollout(
     reproduces the unsharded evaluation bit-for-bit — except under online
     observation normalization, where each lane is normalized by its
     cohort's running statistics and sharding changes the cohort (cohort
-    semantics, like the reference's per-actor stats).
+    semantics, like the reference's per-actor stats). A sharded caller that
+    additionally passes ``stats_sync_axis`` (its shard_map axis name)
+    psum-merges the stat deltas EVERY STEP, so all shards normalize by the
+    mesh-global cohort and the cohort divergence disappears (at the cost of
+    one tiny collective per control step; ``VecNE(obs_norm_sync="step")``).
 
     The logic mirrors ``VecGymNE._evaluate_subbatch``
     (``vecgymne.py:744-916``): one sub-environment per solution, lockstep
@@ -552,6 +581,7 @@ def run_vectorized_rollout(
         observation_normalization=observation_normalization,
         compute_dtype=compute_dtype,
         lane_ids=lane_ids,
+        stats_sync_axis=stats_sync_axis,
     )
     step = _make_step(
         env,
@@ -564,6 +594,7 @@ def run_vectorized_rollout(
         action_noise_stdev=action_noise_stdev,
         compute_dtype=compute_dtype,
         budget_mode=budget_mode,
+        stats_sync_axis=stats_sync_axis,
     )
 
     ctx = _forward_ctx(policy, params_batch)
@@ -582,7 +613,15 @@ def run_vectorized_rollout(
     else:
 
         def cond(c: RolloutCarry):
-            return jnp.any(c.active) & (c.t_global < hard_cap)
+            any_active = jnp.any(c.active)
+            if stats_sync_axis is not None:
+                # per-step collectives in the body require every shard to run
+                # the same number of iterations: keep looping while ANY shard
+                # still has an active lane
+                any_active = (
+                    jax.lax.psum(any_active.astype(jnp.int32), stats_sync_axis) > 0
+                )
+            return any_active & (c.t_global < hard_cap)
 
         final = jax.lax.while_loop(cond, lambda c: step(params_batch, ctx, c), carry)
         mean_scores = final.scores / jnp.maximum(final.episodes_done, 1)
@@ -616,6 +655,7 @@ def _compacting_fns(
     decrease_rewards_by,
     action_noise_stdev,
     compute_dtype,
+    stats_sync_axis=None,
 ):
     """Jitted building blocks of the compacting runner, cached per config so
     repeated calls (every generation) hit XLA's compile cache."""
@@ -630,6 +670,7 @@ def _compacting_fns(
         action_noise_stdev=action_noise_stdev,
         compute_dtype=compute_dtype,
         budget_mode=False,
+        stats_sync_axis=stats_sync_axis,
     )
 
     @jax.jit
@@ -643,6 +684,7 @@ def _compacting_fns(
             observation_normalization=observation_normalization,
             compute_dtype=compute_dtype,
             lane_ids=lane_ids,
+            stats_sync_axis=stats_sync_axis,
         )
 
     @partial(jax.jit, static_argnames=("num_steps",))
@@ -651,7 +693,14 @@ def _compacting_fns(
 
         def cond(s):
             i, c = s
-            return (i < num_steps) & jnp.any(c.active) & (c.t_global < hard_cap)
+            any_active = jnp.any(c.active)
+            if stats_sync_axis is not None:
+                # per-step collectives: every shard must run the same number
+                # of iterations (see _make_step)
+                any_active = (
+                    jax.lax.psum(any_active.astype(jnp.int32), stats_sync_axis) > 0
+                )
+            return (i < num_steps) & any_active & (c.t_global < hard_cap)
 
         def body(s):
             i, c = s
@@ -949,6 +998,7 @@ def _compacting_sharded_fns(
     mesh,
     axis_name: str,
     lowrank: bool,
+    stats_sync: bool = False,
 ):
     from jax.sharding import PartitionSpec as P
 
@@ -963,6 +1013,7 @@ def _compacting_sharded_fns(
         decrease_rewards_by,
         action_noise_stdev,
         compute_dtype,
+        stats_sync_axis=axis_name if stats_sync else None,
     )
     carry_specs = _sharded_carry_specs(env, axis_name)
     params_spec = _params_shard_spec(lowrank, axis_name)
@@ -1045,12 +1096,18 @@ def _compacting_sharded_fns(
     def sh_finalize_local(carry, lane_ids, scores_buf, eps_buf, stats0):
         c = _squeeze_shard_scalars(carry)
         mean_scores, eps_total_local = finalize_fn(c, lane_ids, scores_buf, eps_buf)
-        # merge per-shard obs-norm stat deltas with a psum (the collective
-        # form of the reference's actor delta-sync, gymne.py:524-573)
-        delta = jax.tree_util.tree_map(lambda new, old: new - old, c.stats, stats0)
-        merged = jax.tree_util.tree_map(
-            lambda old, d: old + jax.lax.psum(d, axis_name), stats0, delta
-        )
+        if stats_sync:
+            # per-step psum already made every shard's stats mesh-global; a
+            # final delta merge would count every delta n_shards times
+            merged = c.stats
+        else:
+            # merge per-shard obs-norm stat deltas with a psum (the
+            # collective form of the reference's actor delta-sync,
+            # gymne.py:524-573)
+            delta = jax.tree_util.tree_map(lambda new, old: new - old, c.stats, stats0)
+            merged = jax.tree_util.tree_map(
+                lambda old, d: old + jax.lax.psum(d, axis_name), stats0, delta
+            )
         return (
             mean_scores,
             merged,
@@ -1096,6 +1153,7 @@ def run_vectorized_rollout_compacting_sharded(
     allowed_widths: Optional[tuple] = None,
     prewarm: bool = False,
     return_per_shard_steps: bool = False,
+    stats_sync: bool = False,
 ) -> RolloutResult:
     """``run_vectorized_rollout_compacting`` with the population sharded over
     ``mesh[axis_name]``: each device narrows ITS working set as its lanes
@@ -1112,7 +1170,9 @@ def run_vectorized_rollout_compacting_sharded(
     population — the mesh is an execution detail. (With observation
     normalization, each shard's lanes are normalized by their shard-local
     running statistics mid-rollout — cohort semantics, like the reference's
-    per-actor stats — so sharded scores differ from unsharded ones.)
+    per-actor stats — so sharded scores differ from unsharded ones; pass
+    ``stats_sync=True`` to psum-merge the stat deltas every step instead,
+    making every shard normalize by the mesh-global cohort.)
 
     Not traceable (it syncs lane counts to the host between chunks); call it
     from host code. Returns a :class:`RolloutResult` whose ``stats`` are the
@@ -1141,6 +1201,7 @@ def run_vectorized_rollout_compacting_sharded(
         mesh,
         str(axis_name),
         isinstance(params_batch, LowRankParamsBatch),
+        bool(stats_sync),
     )
 
     if allowed_widths is None:

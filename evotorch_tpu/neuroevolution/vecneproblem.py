@@ -58,6 +58,7 @@ class VecNE(NEProblem):
         num_episodes: int = 1,
         episode_length: Optional[int] = None,
         eval_mode: str = "episodes",
+        obs_norm_sync: str = "cohort",
         compact_config: Optional[dict] = None,
         compute_dtype=None,
         initial_bounds=(-0.00001, 0.00001),
@@ -93,16 +94,26 @@ class VecNE(NEProblem):
         # the masked stat reductions may differ in float summation order
         # only), and WITHOUT observation normalization sharded evaluation is
         # bit-identical to unsharded. With observation normalization on,
-        # sharding still changes scores semantically: each lane is
-        # normalized by its cohort's running statistics, and sharding
-        # changes the cohort each shard's stats see mid-rollout (deltas
-        # psum-merge only at the end, like the reference's per-actor stats).
+        # sharding still changes scores semantically under the default
+        # obs_norm_sync="cohort": each lane is normalized by its cohort's
+        # running statistics, and sharding changes the cohort each shard's
+        # stats see mid-rollout (deltas psum-merge only at the end, like the
+        # reference's per-actor stats). obs_norm_sync="step" instead
+        # psum-merges the stat deltas EVERY control step, so all shards
+        # normalize by the mesh-global cohort and the divergence collapses to
+        # float summation order — at the cost of one small collective per
+        # step (measure before defaulting; test_vecrl characterizes both).
         if eval_mode not in ("episodes", "episodes_compact", "budget"):
             raise ValueError(
                 "eval_mode must be 'episodes', 'episodes_compact' or 'budget',"
                 f" got {eval_mode!r}"
             )
         self._eval_mode = str(eval_mode)
+        if obs_norm_sync not in ("cohort", "step"):
+            raise ValueError(
+                f"obs_norm_sync must be 'cohort' or 'step', got {obs_norm_sync!r}"
+            )
+        self._obs_norm_sync = str(obs_norm_sync)
         # tuning knobs for the lane-compacting runner (chunk_size, min_width,
         # allowed_widths, prewarm); meaningful only with
         # eval_mode="episodes_compact". Widths are GLOBAL population widths:
@@ -376,6 +387,7 @@ class VecNE(NEProblem):
                 action_noise_stdev=self._action_noise_stdev,
                 compute_dtype=self._compute_dtype,
                 prewarm=self._take_prewarm(n),
+                stats_sync=(obsnorm and self._obs_norm_sync == "step"),
                 **self._sharded_compact_config(n_shards),
             )
             if obsnorm:
@@ -385,6 +397,8 @@ class VecNE(NEProblem):
             self.update_status(self._report_counters(batch))
             return
         eval_mode = self._eval_mode
+
+        step_sync = obsnorm and self._obs_norm_sync == "step"
 
         def local(values_shard, key, stats):
             # per-lane PRNG chains seeded by GLOBAL lane ids (same key on
@@ -405,12 +419,20 @@ class VecNE(NEProblem):
                 action_noise_stdev=self._action_noise_stdev,
                 compute_dtype=self._compute_dtype,
                 eval_mode=eval_mode,
+                stats_sync_axis=axis_name if step_sync else None,
             )
-            # merge the per-shard stat deltas with a psum
-            delta = jax.tree_util.tree_map(lambda new, old: new - old, result.stats, stats)
-            merged = jax.tree_util.tree_map(
-                lambda old, d: old + jax.lax.psum(d, axis_name), stats, delta
-            )
+            if step_sync:
+                # the per-step psum already made every shard's stats
+                # mesh-global; a final delta merge would double-count
+                merged = result.stats
+            else:
+                # merge the per-shard stat deltas with a psum
+                delta = jax.tree_util.tree_map(
+                    lambda new, old: new - old, result.stats, stats
+                )
+                merged = jax.tree_util.tree_map(
+                    lambda old, d: old + jax.lax.psum(d, axis_name), stats, delta
+                )
             return (
                 result.scores,
                 merged,

@@ -9,8 +9,9 @@ can be justified or replaced with data (recorded in BENCH_NOTES.md).
 
 Knobs: TUNE_POPSIZE (default 10000 TPU / 1024 CPU), TUNE_EPISODE_LENGTH
 (200/100), TUNE_GENERATIONS (2), TUNE_CHUNKS ("10,25,50,100"),
-TUNE_MINWIDTHS ("128,256,512,0"; 0 = the runner's own default floor),
-BENCH_BF16=1 for bfloat16 compute.
+TUNE_MINWIDTHS ("128,512,0"; 0 = the runner's own default floor, which
+already resolves to 256 at the flagship popsize), BENCH_ENV /
+BENCH_ENV_ARGS (same as bench.py), BENCH_BF16=1 for bfloat16 compute.
 """
 
 import json
@@ -48,7 +49,10 @@ def main():
     widths = [int(w) for w in os.environ.get("TUNE_MINWIDTHS", "128,512,0").split(",")]
     compute_dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
 
-    env = make_env(os.environ.get("BENCH_ENV", "humanoid"))
+    env = make_env(
+        os.environ.get("BENCH_ENV", "humanoid"),
+        **json.loads(os.environ.get("BENCH_ENV_ARGS", "{}")),
+    )
     policy = build_policy(env)
     stats = RunningNorm(env.observation_size).stats
     state = fresh_pgpe_state(policy.parameter_count)

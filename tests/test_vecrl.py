@@ -672,3 +672,96 @@ def test_vecne_sharded_obs_norm_divergence_bounded():
         / (np.abs(np.asarray(st_plain.mean)) + 0.1)
     )
     assert mean_diff < 0.15, mean_diff
+
+
+def test_vecne_sharded_obs_norm_step_sync_matches_unsharded():
+    # obs_norm_sync="step": the stat deltas psum-merge every control step, so
+    # every shard normalizes by the MESH-GLOBAL cohort — the cohort
+    # divergence (characterized in the test above) collapses to float
+    # summation order. Reduction-order noise is amplified exponentially by
+    # the contact dynamics (measured on hopper: max per-lane score diff
+    # 9e-7 at T=2, 4e-3 at T=10, 0.3 at T=40), so the per-lane assertion
+    # runs at a short horizon where it is meaningful; the absorbed
+    # observation COUNT must match exactly at any horizon (the semantic
+    # invariant — cohort mode can diverge even there, since actions differ).
+    from evotorch_tpu.core import SolutionBatch
+    from evotorch_tpu.neuroevolution import VecNE
+
+    def make(sync):
+        return VecNE(
+            "hopper",
+            "Linear(obs_length, 8) >> Tanh() >> Linear(8, act_length)",
+            episode_length=10,
+            observation_normalization=True,
+            obs_norm_sync=sync,
+            seed=33,
+        )
+
+    rng = np.random.default_rng(14)
+    p_plain, p_sync = make("cohort"), make("step")
+    values = jnp.asarray(
+        rng.normal(size=(64, p_plain.solution_length)) * 0.2, jnp.float32
+    )
+    b_plain = SolutionBatch(p_plain, values=values)
+    b_sync = SolutionBatch(p_sync, values=values)
+    p_plain.evaluate(b_plain)         # unsharded: the global cohort
+    p_sync.evaluate_sharded(b_sync)   # sharded with per-step stat sync
+
+    np.testing.assert_allclose(
+        np.asarray(b_sync.evals_of(0)), np.asarray(b_plain.evals_of(0)),
+        atol=2e-2,
+    )
+    # the absorbed observation count matches EXACTLY: every shard saw the
+    # global cohort, so the same episodes terminated at the same steps
+    assert float(p_sync._obs_norm.count) == float(p_plain._obs_norm.count)
+    np.testing.assert_allclose(
+        np.asarray(p_sync._obs_norm.mean), np.asarray(p_plain._obs_norm.mean),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_sharded_compacting_obs_norm_step_sync():
+    # the compacting sharded runner with stats_sync=True: scores match the
+    # unsharded monolithic episodes evaluation to float-order tolerance,
+    # and the returned stats are already mesh-global (no double count)
+    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+    from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+    from evotorch_tpu.neuroevolution.net.vecrl import (
+        run_vectorized_rollout,
+        run_vectorized_rollout_compacting_sharded,
+    )
+    from evotorch_tpu.envs import make_env
+    from evotorch_tpu.parallel.mesh import default_mesh
+
+    env = make_env("hopper")
+    net = Linear(env.observation_size, 8) >> Tanh() >> Linear(8, env.action_size)
+    policy = FlatParamsPolicy(net)
+    rng = np.random.default_rng(7)
+    values = jnp.asarray(
+        rng.normal(size=(32, policy.parameter_count)) * 0.2, jnp.float32
+    )
+    stats = RunningNorm(env.observation_size).stats
+    mesh = default_mesh(("pop",))
+
+    # short horizon: reduction-order noise amplifies exponentially through
+    # the contact dynamics (see the step-sync VecNE test above)
+    r_ref = run_vectorized_rollout(
+        env, policy, values, jax.random.key(5), stats,
+        num_episodes=1, episode_length=10, observation_normalization=True,
+        eval_mode="episodes",
+    )
+    # min_width=1 -> per-shard widths (1, 2) actually exist (n_local=4), so
+    # the rollout exercises real compaction jumps WITH the per-step stat
+    # collectives — the riskiest interaction of the feature
+    r_sync = run_vectorized_rollout_compacting_sharded(
+        env, policy, values, jax.random.key(5), stats,
+        mesh=mesh, num_episodes=1, episode_length=10,
+        observation_normalization=True, stats_sync=True,
+        min_width=1, chunk_size=2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_sync.scores), np.asarray(r_ref.scores), atol=2e-2
+    )
+    # exact: every shard absorbed the global cohort every step
+    assert float(r_sync.stats.count) == float(r_ref.stats.count)
+    assert int(r_sync.total_episodes) == 32
