@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-5 locomotion evidence queue: wait for any running curve to finish,
+# then run the remaining envs sequentially with the recipe that made
+# Humanoid walk (alive bonus removed from the search signal, ClipUp
+# max_speed 0.15, MLP-64, adaptive popsize under an interaction budget).
+set -u
+cd "$(dirname "$0")/.."
+while pgrep -f "python locomotion_curve" >/dev/null; do sleep 30; done
+for envname in walker2d hopper ant; do
+  nice -n 15 python examples/locomotion_curve.py --env "$envname" --cpu \
+    --popsize 200 --generations 300 --episode-length 200 --eval-every 10 \
+    --decrease-rewards-by auto --num-interactions 30000 --popsize-max 1600 \
+    --max-speed 0.15 \
+    --network "Linear(obs_length, 64) >> Tanh() >> Linear(64, act_length)" \
+    --out "bench_curves/${envname}_cpu_r5.jsonl" \
+    > "bench_curves/${envname}_cpu_r5.log" 2>&1
+done
+echo done > bench_curves/curve_queue_r5.done
