@@ -1,0 +1,15 @@
+#!/bin/bash
+# Second round-5 evidence queue: after the popsize-10k Humanoid run frees
+# the core, demonstrate that the FACTORED (low-rank) population path learns
+# the flagship env end-to-end — the algorithmic-soundness complement to the
+# equality tests and throughput numbers.
+set -u
+cd "$(dirname "$0")/.."
+while pgrep -f "python locomotion_curve" >/dev/null; do sleep 60; done
+nice -n 15 python examples/locomotion_curve.py --env humanoid --cpu \
+  --popsize 200 --generations 300 --episode-length 200 --eval-every 10 \
+  --decrease-rewards-by auto --max-speed 0.15 --lowrank-rank 32 \
+  --network "Linear(obs_length, 64) >> Tanh() >> Linear(64, act_length)" \
+  --out bench_curves/humanoid_cpu_r5_lowrank.jsonl \
+  > bench_curves/humanoid_cpu_r5_lowrank.log 2>&1
+echo done > bench_curves/curve_queue2_r5.done
