@@ -1,0 +1,26 @@
+#!/bin/bash
+# Third round-5 evidence queue (idle-core work while the TPU watcher waits):
+# ant to its ceiling, then recurrent policies (RNN/LSTM) learning hopper —
+# end-to-end training evidence for the recurrent rollout path.
+set -u
+cd "$(dirname "$0")/.."
+while pgrep -f "python locomotion_curve" >/dev/null; do sleep 60; done
+nice -n 15 python examples/locomotion_curve.py --env ant --cpu \
+  --popsize 200 --generations 1000 --episode-length 200 --eval-every 20 \
+  --decrease-rewards-by auto --num-interactions 30000 --popsize-max 1600 \
+  --max-speed 0.15 \
+  --network "Linear(obs_length, 64) >> Tanh() >> Linear(64, act_length)" \
+  --out bench_curves/ant_cpu_r5_1000.jsonl \
+  > bench_curves/ant_cpu_r5_1000.log 2>&1
+nice -n 15 python examples/locomotion_curve.py --env hopper --cpu \
+  --popsize 200 --generations 300 --episode-length 200 --eval-every 10 \
+  --max-speed 0.15 \
+  --network "RNN(obs_length, 32) >> Linear(32, act_length)" \
+  --out bench_curves/hopper_rnn_cpu_r5.jsonl \
+  > bench_curves/hopper_rnn_cpu_r5.log 2>&1
+nice -n 15 python examples/locomotion_curve.py --env hopper --cpu \
+  --popsize 200 --generations 300 --episode-length 200 --eval-every 10 \
+  --max-speed 0.15 \
+  --network "LSTM(obs_length, 32) >> Linear(32, act_length)" \
+  --out bench_curves/hopper_lstm_cpu_r5.jsonl \
+  > bench_curves/hopper_lstm_cpu_r5.log 2>&1
