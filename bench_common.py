@@ -73,6 +73,16 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
             if "BENCH_COMPACT_MINWIDTH" in os.environ
             else None
         ),
+        # lane-refill tuning (episodes_refill only): the fixed lane width W
+        # (default: engine picks ~work/8) and the refill period (refill every
+        # k-th step; >1 amortizes the refill gather/reset at the cost of
+        # finished lanes idling up to k-1 steps)
+        "refill_width": (
+            int(os.environ["BENCH_REFILL_WIDTH"])
+            if "BENCH_REFILL_WIDTH" in os.environ
+            else None
+        ),
+        "refill_period": int(os.environ.get("BENCH_REFILL_PERIOD", "1")),
     }
 
 
@@ -83,6 +93,16 @@ def compact_kwargs(cfg: dict, *, n_shards: int = 1) -> dict:
     kwargs = {"chunk_size": cfg["compact_chunk"]}
     if cfg["compact_min_width"] is not None:
         kwargs["min_width"] = max(1, cfg["compact_min_width"] // n_shards)
+    return kwargs
+
+
+def refill_kwargs(cfg: dict, *, n_shards: int = 1) -> dict:
+    """Lane-refill engine kwargs from the BENCH knobs. The width knob is
+    GLOBAL; pass ``n_shards`` to translate (flooring, like the other
+    convenience knobs) for a per-shard sharded rollout."""
+    kwargs = {"refill_period": cfg["refill_period"]}
+    if cfg["refill_width"] is not None:
+        kwargs["refill_width"] = max(1, cfg["refill_width"] // n_shards)
     return kwargs
 
 

@@ -28,6 +28,7 @@ from bench_common import (
     build_policy,
     compact_kwargs,
     fresh_pgpe_state,
+    refill_kwargs,
     setup_backend,
 )
 
@@ -78,6 +79,14 @@ def main():
     stats = RunningNorm(env.observation_size).stats
     state = fresh_pgpe_state(policy.parameter_count)
 
+    # per-shard refill queues: the width knob is global, the seed stride is
+    # the global popsize (unique (solution, episode) seeds across shards)
+    rkw = (
+        dict(refill_kwargs(cfg, n_shards=mesh_size), seed_stride=popsize)
+        if eval_mode == "episodes_refill"
+        else {}
+    )
+
     def local_rollout(values_shard, key, stats):
         # per-lane PRNG chains seeded by GLOBAL lane ids (same key on every
         # shard): the sharded program's realized randomness is identical to
@@ -96,6 +105,7 @@ def main():
             episode_length=episode_length,
             compute_dtype=compute_dtype,
             eval_mode=eval_mode,
+            **rkw,
         )
         delta = jax.tree_util.tree_map(lambda new, old: new - old, result.stats, stats)
         merged = jax.tree_util.tree_map(

@@ -877,9 +877,14 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
                 )
                 self._drop_sharded_evaluation()
             else:
+                # keep the hook payload to the reference's key set; the basis
+                # (subspace-exhaustion diagnostic) re-attaches afterwards
+                basis = result.pop("basis", None)
                 hook_results = self.after_grad_hook.accumulate_dict(result)
                 if hook_results:
                     self.update_status(hook_results)
+                if basis is not None:
+                    result["basis"] = basis
                 return [result]
 
         def sample_and_eval(key, n, basis=None):
@@ -957,6 +962,11 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         hook_results = self.after_grad_hook.accumulate_dict(result)
         if hook_results:
             self.update_status(hook_results)
+        if isinstance(all_samples, LowRankParamsBatch):
+            # the generation's basis, for the subspace-exhaustion diagnostic
+            # (gaussian.py:_update_basis_capture); attached after the hook
+            # pass so hook payloads keep the reference's key set
+            result["basis"] = all_samples.basis
         return [result]
 
     def _drop_sharded_evaluation(self):
@@ -1014,11 +1024,18 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
             self._sharded_grad_cache[cache_key] = estimator
 
         grads, aux = estimator(key, total, distribution.parameters)
-        return {
+        result = {
             "gradients": grads,
             "num_solutions": int(total),
             "mean_eval": aux["mean_eval"],  # device scalar: stays lazy
         }
+        if "basis" in aux:
+            # per-shard bases ride out stacked along the pop axis; shard 0's
+            # rows are a representative iid draw for the subspace-exhaustion
+            # diagnostic (every shard's basis is an independent draw at the
+            # same rank, so the capture statistics are exchangeable)
+            result["basis"] = aux["basis"][: self.solution_length]
+        return result
 
     # ----------------------------------------------------------------- misc
     def ensure_numeric(self):

@@ -17,6 +17,16 @@ and between chunks the still-active lanes are sorted to the front and the
 working width shrinks to the smallest allowed power-of-two that holds them —
 so once most of the population has finished, the machine stops paying for
 the dead lanes.
+
+``eval_mode="episodes_refill"`` is the work-conserving alternative
+(continuous batching for rollouts, after the Podracer always-on device
+loops, arXiv:2104.06272): a FIXED lane width ``W <= popsize * num_episodes``,
+a pending-work queue carried in the ``lax.while_loop`` state, and an
+on-device refill step that reloads a finishing lane with the next pending
+(solution, episode) item — fresh env reset from the item's own PRNG seed,
+policy parameters gathered into the lane slot, episode return credited to
+the right solution by segment reduction. No host round-trip, no re-trace,
+no padding to the longest survivor.
 """
 
 from __future__ import annotations
@@ -232,6 +242,29 @@ def _env_state_select(env, mask, a, b):
     return jax.tree_util.tree_map(select, a, b)
 
 
+def _lane_select(mask, new, old):
+    """Per-lane row select with ``mask`` broadcast over trailing dims."""
+    m = mask.reshape(mask.shape + (1,) * (new.ndim - 1))
+    return jnp.where(m, new, old)
+
+
+def _initial_policy_states(policy: FlatParamsPolicy, n: int, compute_dtype):
+    """The width-``n`` batch of initial recurrent states (``None`` for a
+    stateless policy), in the compute dtype (recurrent state lives in compute
+    dtype) — the one definition of a lane's fresh policy state, shared by
+    rollout init and the refill engine."""
+    proto = policy.initial_state()
+    if proto is None:
+        return None
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.broadcast_to(
+            leaf if compute_dtype is None else leaf.astype(compute_dtype),
+            (n,) + leaf.shape,
+        ),
+        proto,
+    )
+
+
 def _env_state_take(env, states, idx):
     """Gather lanes ``idx`` out of a batched env state (lane compaction)."""
     if getattr(env, "batched_native", False):
@@ -292,18 +325,7 @@ def _rollout_init(
             new_stats = _stats_psum_merge(stats, new_stats, stats_sync_axis)
         stats = new_stats
 
-    policy_proto = policy.initial_state()
-    if policy_proto is None:
-        policy_states = None
-    else:
-        state_dtype = compute_dtype  # recurrent state lives in compute dtype
-        policy_states = jax.tree_util.tree_map(
-            lambda leaf: jnp.broadcast_to(
-                leaf if state_dtype is None else leaf.astype(state_dtype),
-                (n,) + leaf.shape,
-            ),
-            policy_proto,
-        )
+    policy_states = _initial_policy_states(policy, n, compute_dtype)
 
     carry = RolloutCarry(
         env_states=env_states,
@@ -423,10 +445,6 @@ def _make_step(
         finished = dones & active_f
         episodes_done = c.episodes_done + finished.astype(jnp.int32)
 
-        def select(mask, new, old):
-            m = mask.reshape(mask.shape + (1,) * (new.ndim - 1))
-            return jnp.where(m, new, old)
-
         if auto_reset:
             # auto-reset the envs that finished an episode (reset keys come
             # from the per-lane chains: width-independent)
@@ -434,7 +452,7 @@ def _make_step(
             env_states_next = _env_state_select(
                 env, finished, fresh_states, new_env_states
             )
-            obs_next = select(finished, fresh_obs, new_obs)
+            obs_next = _lane_select(finished, fresh_obs, new_obs)
             steps_in_episode = jnp.where(finished, 0, steps_in_episode)
             if new_policy_states is not None:
                 new_policy_states = reset_tensors(new_policy_states, finished)
@@ -449,7 +467,7 @@ def _make_step(
             env_states_next = _env_state_select(
                 env, active, new_env_states, c.env_states
             )
-            obs_next = select(active, new_obs, c.obs)
+            obs_next = _lane_select(active, new_obs, c.obs)
             steps_in_episode = jnp.where(active, steps_in_episode, 0)
 
         if budget_mode:
@@ -498,6 +516,9 @@ def _make_step(
         "compute_dtype",
         "eval_mode",
         "stats_sync_axis",
+        "refill_width",
+        "refill_period",
+        "seed_stride",
     ),
 )
 def run_vectorized_rollout(
@@ -517,6 +538,9 @@ def run_vectorized_rollout(
     eval_mode: str = "episodes",
     lane_ids=None,
     stats_sync_axis: Optional[str] = None,
+    refill_width: Optional[int] = None,
+    refill_period: int = 1,
+    seed_stride: Optional[int] = None,
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
 
@@ -563,12 +587,57 @@ def run_vectorized_rollout(
       interactions — on accelerators this is the throughput-optimal contract
       (it also gives low-variance fitness: constant compute per solution, no
       survivorship skew). This is the flagship benchmark path.
+    - ``"episodes_refill"``: the same contract as ``"episodes"`` (each
+      solution's score is the mean return of exactly ``num_episodes``
+      episodes) evaluated by the work-conserving lane-refill scheduler: a
+      fixed width ``refill_width`` of lanes is kept saturated by refilling
+      each finishing lane with the next pending (solution, episode) item
+      from an on-device queue — continuous batching for rollouts. One jitted
+      program (usable inside jit/shard_map, unlike the compacting runner),
+      no padding to the longest survivor. ``refill_period`` refills only
+      every that-many steps (finished lanes wait masked in between),
+      amortizing the refill gather/reset; ``seed_stride`` must be the GLOBAL
+      popsize on a sharded caller so (solution, episode) seeds stay unique
+      across shards. At ``num_episodes=1`` without observation
+      normalization the scores are bit-identical to
+      ``eval_mode="episodes"`` for the same ``key`` (matched per-lane
+      seeding); at ``num_episodes > 1`` each episode runs on its own PRNG
+      chain, so scores are distribution-equivalent, not bit-equal. With
+      observation normalization ON the refill schedule itself changes the
+      running statistics each lane sees mid-rollout (a lane refilled late
+      is normalized by more history than its monolithic counterpart), so
+      scores differ semantically from ``"episodes"`` — schedule-dependent
+      cohort statistics, exactly like sharding under
+      ``obs_norm_sync="cohort"``.
     """
-    if eval_mode not in ("episodes", "budget"):
-        raise ValueError(f"eval_mode must be 'episodes' or 'budget', got {eval_mode!r}")
+    if eval_mode not in ("episodes", "budget", "episodes_refill"):
+        raise ValueError(
+            "eval_mode must be 'episodes', 'budget' or 'episodes_refill',"
+            f" got {eval_mode!r}"
+        )
     max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
     if episode_length is not None:
         max_t = min(max_t, int(episode_length))
+    if eval_mode == "episodes_refill":
+        return _run_refill(
+            env,
+            policy,
+            params_batch,
+            key,
+            stats,
+            num_episodes=int(num_episodes),
+            max_t=max_t,
+            observation_normalization=observation_normalization,
+            alive_bonus_schedule=alive_bonus_schedule,
+            decrease_rewards_by=decrease_rewards_by,
+            action_noise_stdev=action_noise_stdev,
+            compute_dtype=compute_dtype,
+            lane_ids=lane_ids,
+            stats_sync_axis=stats_sync_axis,
+            refill_width=refill_width,
+            refill_period=refill_period,
+            seed_stride=seed_stride,
+        )
     hard_cap = max_t * int(num_episodes) + 1
     budget_mode = eval_mode == "budget"
 
@@ -641,6 +710,362 @@ def _pow2_at_least(x: int) -> int:
     while p < x:
         p *= 2
     return p
+
+
+# ---------------------- work-conserving lane-refill engine ----------------------
+# Continuous batching for the episodes contract: the whole evaluation is ONE
+# lax.while_loop over a fixed width W, kept saturated by refilling finished
+# lanes from an on-device pending-work queue. Unlike the compacting runner
+# (host-orchestrated chunks, per-width re-traces) this is a single jitted
+# program, usable inside jit/shard_map, and never pads a batch to its
+# longest survivor — the large-win regime is exactly the flagship
+# popsize-10k shape with skewed episode-death times.
+
+
+class RefillCarry(NamedTuple):
+    """Loop state of the refill engine. ``lane_*`` leaves are per-lane
+    (width ``W``); ``scores_buf``/``eps_buf`` are per-SOLUTION buffers
+    (length ``N``) fed by segment reduction; ``next_item`` is the head of the
+    pending-work queue (items are (solution, episode) pairs, encoded
+    ``item = episode * N + solution``)."""
+
+    env_states: Any
+    obs: jnp.ndarray
+    policy_states: Any
+    lane_params: Any  # (W, L) dense rows or (W, k) low-rank coefficients
+    lane_sol: jnp.ndarray  # (W,) local solution index each lane is running
+    lane_score: jnp.ndarray  # (W,) return of the lane's CURRENT episode
+    steps_in_episode: jnp.ndarray
+    active: jnp.ndarray
+    scores_buf: jnp.ndarray  # (N,) summed episodic returns per solution
+    eps_buf: jnp.ndarray  # (N,) episodes credited per solution
+    next_item: jnp.ndarray  # scalar int32 queue head
+    stats: CollectedStats
+    key: Any  # (W,) per-lane PRNG chains
+    total_steps: jnp.ndarray
+    t_global: jnp.ndarray
+
+
+def _default_refill_width(total_items: int) -> int:
+    """W defaults to ~1/8 of the work-list (pow2, floor 128): small enough
+    that the queue keeps lanes saturated until near the end, large enough to
+    amortize per-step fixed costs."""
+    return min(total_items, max(128, _pow2_at_least(max(1, total_items // 8))))
+
+
+def _refill_forward_setup(policy, params_batch):
+    """Per-lane parameter storage + forward for the refill engine.
+
+    The loop carries only the PER-LANE slice of the population (dense rows,
+    or low-rank coefficients — the shared center/basis stay loop-invariant
+    closures), so a refill gathers O(W x row), never the whole population.
+    Returns ``(store, forward)``: ``store`` is the (N, row) gather source and
+    ``forward(lane_params, obs, states)`` runs the policy at width W."""
+    if isinstance(params_batch, LowRankParamsBatch):
+        from .lowrank import _apply_lowrank, lowrank_supported, prepare_lowrank
+
+        if lowrank_supported(policy.module):
+            prepared = prepare_lowrank(policy, params_batch)
+
+            def forward(lane_coeffs, obs, states):
+                return _apply_lowrank(
+                    policy.module,
+                    prepared.center_tree,
+                    prepared.basis_tree,
+                    lane_coeffs,
+                    obs,
+                    states,
+                )
+
+        else:
+            import warnings
+
+            # the same LOUD-fallback contract as net/lowrank.py (VERDICT r3
+            # #3): the caller chose the factored representation to avoid
+            # dense parameter rows, and here they get rebuilt every step
+            warnings.warn(
+                "low-rank refill forward fell back to materializing dense "
+                f"per-lane parameter rows (W, {params_batch.center.shape[-1]}) "
+                f"every step: {type(policy.module).__name__} has no "
+                "structured low-rank path (supported: Sequential stacks of "
+                "Linear/Bias/RNN/LSTM/parameterless layers)",
+                stacklevel=3,
+            )
+
+            def forward(lane_coeffs, obs, states):
+                dense = params_batch.materialize_rows(lane_coeffs)
+                return _batched_forward(policy, dense, None, obs, states)
+
+        return params_batch.coeffs, forward
+
+    def forward(lane_params, obs, states):
+        return _batched_forward(policy, lane_params, None, obs, states)
+
+    return params_batch, forward
+
+
+def _run_refill(
+    env,
+    policy: FlatParamsPolicy,
+    params_batch,
+    key,
+    stats: CollectedStats,
+    *,
+    num_episodes: int,
+    max_t: int,
+    observation_normalization: bool,
+    alive_bonus_schedule,
+    decrease_rewards_by,
+    action_noise_stdev,
+    compute_dtype,
+    lane_ids,
+    stats_sync_axis,
+    refill_width,
+    refill_period,
+    seed_stride,
+) -> RolloutResult:
+    """The ``episodes_refill`` evaluation: exact ``episodes`` semantics (each
+    solution is scored by the mean return of exactly ``num_episodes``
+    episodes), evaluated work-conservingly at fixed width. Called inside the
+    ``run_vectorized_rollout`` trace."""
+    if not jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        # legacy raw uint32 keys (jax.random.PRNGKey): wrap into a typed key
+        # array so the per-lane chains stay rank-1 and the refill step's
+        # jnp.where lane-selects work on them. The monolithic engine only
+        # ever vmaps fold_in/split over its keys, so it accepts either form
+        # — and wrapping preserves the key bits, so matched-seed
+        # bit-identity to it holds for legacy keys too.
+        key = jax.random.wrap_key_data(key)
+    n = _params_popsize(params_batch)
+    total_items = n * int(num_episodes)
+    width = refill_width if refill_width is not None else _default_refill_width(total_items)
+    width = int(min(max(1, int(width)), total_items))
+    period = max(1, int(refill_period))
+    stride = int(seed_stride) if seed_stride is not None else n
+
+    params_batch = _params_cast(params_batch, compute_dtype)
+    if lane_ids is None:
+        lane_ids = jnp.arange(n, dtype=jnp.int32)
+    store, forward = _refill_forward_setup(policy, params_batch)
+
+    def item_keys(items):
+        """(chain, reset) PRNG keys + solution index of queue items. Episode
+        ``e`` of solution ``s`` is seeded ``fold_in(key, lane_ids[s] +
+        e * seed_stride)`` — at e=0 exactly the monolithic runner's per-lane
+        seeding, so matched-seed refill reproduces plain ``episodes``
+        bit-for-bit at ``num_episodes=1`` (observation normalization off —
+        see the ``run_vectorized_rollout`` docstring), for ANY width,
+        sharded or not (``seed_stride`` must be the GLOBAL popsize on a
+        sharded caller)."""
+        sol = items % n
+        ep = items // n
+        seeds = lane_ids[sol] + ep * jnp.int32(stride)
+        ik = jax.vmap(lambda s: jax.random.fold_in(key, s))(seeds)
+        pair = jax.vmap(lambda k: jax.random.split(k, 2))(ik)
+        return pair[:, 0], pair[:, 1], sol
+
+    items0 = jnp.arange(width, dtype=jnp.int32)
+    chain0, reset0, sol0 = item_keys(items0)
+    env_states0, obs0 = _env_reset(env, reset0)
+    if observation_normalization:
+        new_stats = stats_update(stats, obs0, mask=jnp.ones(width, dtype=bool))
+        if stats_sync_axis is not None:
+            new_stats = _stats_psum_merge(stats, new_stats, stats_sync_axis)
+        stats = new_stats
+
+    policy_states0 = _initial_policy_states(policy, width, compute_dtype)
+
+    carry = RefillCarry(
+        env_states=env_states0,
+        obs=obs0,
+        policy_states=policy_states0,
+        lane_params=store[sol0],
+        lane_sol=sol0,
+        lane_score=jnp.zeros(width),
+        steps_in_episode=jnp.zeros(width, dtype=jnp.int32),
+        active=jnp.ones(width, dtype=bool),
+        scores_buf=jnp.zeros(n, dtype=jnp.float32),
+        eps_buf=jnp.zeros(n, dtype=jnp.int32),
+        next_item=jnp.asarray(width, dtype=jnp.int32),
+        stats=stats,
+        key=chain0,
+        total_steps=jnp.zeros((), dtype=jnp.int32),
+        t_global=jnp.zeros((), dtype=jnp.int32),
+    )
+
+    def step(c: RefillCarry) -> RefillCarry:
+        # the per-lane chains advance ONLY when this config draws action
+        # noise (refill resets use the item's own key, not the lane chain) —
+        # the same 3-way split discipline as the monolithic engine, so the
+        # realized noise matches it draw-for-draw
+        if action_noise_stdev is not None:
+            triple = jax.vmap(lambda k: jax.random.split(k, 3))(c.key)
+            lane_keys, noise_keys = triple[:, 0], triple[:, 1]
+        else:
+            lane_keys, noise_keys = c.key, None
+
+        policy_in = (
+            stats_normalize(c.stats, c.obs) if observation_normalization else c.obs
+        )
+        if compute_dtype is not None:
+            policy_in = policy_in.astype(compute_dtype)
+        raw, new_policy_states = forward(c.lane_params, policy_in, c.policy_states)
+        if compute_dtype is not None:
+            raw = raw.astype(jnp.float32)
+
+        noise = None
+        if action_noise_stdev is not None:
+            noise = action_noise_stdev * jax.vmap(
+                lambda k: jax.random.normal(k, raw.shape[1:])
+            )(noise_keys)
+        actions = _policy_to_action(raw, env.action_space, noise, clip=True)
+
+        if getattr(env, "batched_native", False):
+            new_env_states, new_obs, rewards, dones = env.batch_step(
+                c.env_states, actions
+            )
+        else:
+            new_env_states, new_obs, rewards, dones = jax.vmap(env.step)(
+                c.env_states, actions
+            )
+
+        steps_in_episode = c.steps_in_episode + 1
+        dones = dones | (steps_in_episode >= max_t)
+        if decrease_rewards_by is not None:
+            rewards = rewards - decrease_rewards_by
+        if alive_bonus_schedule is not None:
+            rewards = rewards + alive_bonus_for_step(
+                steps_in_episode, alive_bonus_schedule
+            ) * (~dones)
+
+        active_f = c.active
+        lane_score = c.lane_score + jnp.where(active_f, rewards, 0.0)
+        finished = dones & active_f
+        # segment reduction: credit finished episodes to their solutions
+        # (idle lanes contribute an exact 0.0 to whatever row they last ran)
+        scores_buf = c.scores_buf.at[c.lane_sol].add(
+            jnp.where(finished, lane_score, 0.0)
+        )
+        eps_buf = c.eps_buf.at[c.lane_sol].add(finished.astype(jnp.int32))
+        total_steps = c.total_steps + jnp.sum(active_f.astype(jnp.int32))
+
+        running = active_f & ~finished
+        # freeze non-running lanes at their pre-step state (the monolithic
+        # engine's no-reset trick: bounded states, no NaN leakage) and reset
+        # their per-episode bookkeeping so a later refill starts clean.
+        # Policy states return to the policy's INITIAL state — not zeros —
+        # so a refilled episode starts exactly like _rollout_init's (the
+        # bit-identity contract must hold for stateful policies whose
+        # initial_state() is nonzero, not just the built-in RNN/LSTM zeros)
+        env_states_base = _env_state_select(env, running, new_env_states, c.env_states)
+        obs_base = _lane_select(running, new_obs, c.obs)
+        steps_base = jnp.where(running, steps_in_episode, 0)
+        lane_score = jnp.where(running, lane_score, 0.0)
+        policy_states_base = (
+            None
+            if new_policy_states is None
+            else jax.tree_util.tree_map(
+                lambda s, init: _lane_select(running, s, init),
+                new_policy_states,
+                policy_states0,
+            )
+        )
+
+        idle = ~running
+        gate = jnp.any(idle) & (c.next_item < total_items)
+        if period > 1:
+            gate = gate & (((c.t_global + 1) % period) == 0)
+        # ranks among idle lanes -> candidate queue items; lanes beyond the
+        # queue end stay idle (drained). Computed outside the cond so both
+        # branches agree on `take`'s provenance.
+        offs = jnp.cumsum(idle.astype(jnp.int32)) - 1
+        cand = c.next_item + offs
+        take = idle & (cand < total_items) & gate
+
+        def do_refill(op):
+            env_states, obs_cur, lane_params, lane_sol, keys = op
+            chain, reset_k, sol = item_keys(jnp.where(take, cand, 0))
+            fresh_states, fresh_obs = _env_reset(env, reset_k)
+            env_states = _env_state_select(env, take, fresh_states, env_states)
+            obs_cur = _lane_select(take, fresh_obs, obs_cur)
+            lane_sol = jnp.where(take, sol, lane_sol)
+            lane_params = _lane_select(take, store[sol], lane_params)
+            keys = jnp.where(take, chain, keys)
+            return env_states, obs_cur, lane_params, lane_sol, keys
+
+        def skip_refill(op):
+            return op
+
+        env_states_next, obs_next, lane_params_next, lane_sol_next, keys_next = (
+            jax.lax.cond(
+                gate,
+                do_refill,
+                skip_refill,
+                (env_states_base, obs_base, c.lane_params, c.lane_sol, lane_keys),
+            )
+        )
+        active = running | take
+        next_item = c.next_item + jnp.sum(take.astype(jnp.int32))
+
+        # obs-norm statistics count ONLY live-lane observations: the
+        # post-refill obs each still-active lane will consume next step
+        # (idle/drained lanes are masked out entirely)
+        new_stats = (
+            stats_update(c.stats, obs_next, mask=active)
+            if observation_normalization
+            else c.stats
+        )
+        if observation_normalization and stats_sync_axis is not None:
+            new_stats = _stats_psum_merge(c.stats, new_stats, stats_sync_axis)
+
+        return RefillCarry(
+            env_states=env_states_next,
+            obs=obs_next,
+            policy_states=policy_states_base,
+            lane_params=lane_params_next,
+            lane_sol=lane_sol_next,
+            lane_score=lane_score,
+            steps_in_episode=steps_base,
+            active=active,
+            scores_buf=scores_buf,
+            eps_buf=eps_buf,
+            next_item=next_item,
+            stats=new_stats,
+            key=keys_next,
+            total_steps=total_steps,
+            t_global=c.t_global + 1,
+        )
+
+    # greedy-scheduling makespan bound (total work / W + longest item) plus
+    # the refill-period waiting slack — a safety net, not the exit condition
+    hard_cap = (
+        (total_items * max_t) // width
+        + max_t
+        + period * (total_items // width + 1)
+        + 2
+    )
+
+    def cond(c: RefillCarry):
+        # pending queue items keep the loop alive even when every lane is
+        # momentarily idle (all lanes can finish on a step whose refill gate
+        # is closed by refill_period)
+        any_work = jnp.any(c.active) | (c.next_item < total_items)
+        if stats_sync_axis is not None:
+            # per-step collectives in the body require every shard to run the
+            # same number of iterations (see _make_step)
+            any_work = (
+                jax.lax.psum(any_work.astype(jnp.int32), stats_sync_axis) > 0
+            )
+        return any_work & (c.t_global < hard_cap)
+
+    final = jax.lax.while_loop(cond, step, carry)
+    mean_scores = final.scores_buf / jnp.maximum(final.eps_buf, 1).astype(jnp.float32)
+    return RolloutResult(
+        scores=mean_scores,
+        stats=final.stats,
+        total_steps=final.total_steps,
+        total_episodes=jnp.sum(final.eps_buf),
+    )
 
 
 @functools.lru_cache(maxsize=_ENGINE_CACHE_SIZE)

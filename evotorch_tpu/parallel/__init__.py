@@ -21,7 +21,11 @@ reference's actor-pool behavior with plain worker processes.
 """
 
 from .mesh import default_mesh, device_count, make_mesh
-from .evaluate import make_sharded_evaluator, shard_population
+from .evaluate import (
+    make_sharded_evaluator,
+    make_sharded_rollout_evaluator,
+    shard_population,
+)
 from .grad import make_sharded_grad_estimator
 from .hostpool import HostEvaluatorPool
 from .distributed import init_distributed
@@ -31,6 +35,7 @@ __all__ = [
     "device_count",
     "make_mesh",
     "make_sharded_evaluator",
+    "make_sharded_rollout_evaluator",
     "shard_population",
     "make_sharded_grad_estimator",
     "HostEvaluatorPool",

@@ -9,6 +9,7 @@ locally, and the sharded result is reassembled by XLA — no pickling, no RPC.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -17,7 +18,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import default_mesh
 
-__all__ = ["make_sharded_evaluator", "shard_population"]
+# compiled shard_map programs kept per (lowrank, popsize); matches the spirit
+# of vecrl's _ENGINE_CACHE_SIZE bound
+_EVALUATOR_CACHE_SIZE = 64
+
+__all__ = [
+    "make_sharded_evaluator",
+    "make_sharded_rollout_evaluator",
+    "shard_population",
+]
 
 
 def shard_population(values: jnp.ndarray, mesh: Optional[Mesh] = None, axis_name: str = "pop") -> jnp.ndarray:
@@ -72,5 +81,131 @@ def make_sharded_evaluator(
             check_vma=False,
         )(padded)
         return jax.tree_util.tree_map(lambda r: r[:n], result)
+
+    return evaluator
+
+
+def make_sharded_rollout_evaluator(
+    env,
+    policy,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "pop",
+    stats_sync: bool = False,
+    **rollout_kwargs,
+):
+    """Shard_map the monolithic rollout engine
+    (``neuroevolution.net.vecrl.run_vectorized_rollout``) over the mesh's
+    population axis — the reusable form of the sharded-evaluation recipe
+    (``dryrun_multichip`` calls it; ``VecNE._evaluate_all`` and
+    ``bench_multichip`` still carry historical inline copies of the same
+    wiring — keep the three in sync until they migrate here):
+
+    - per-lane PRNG chains seeded by GLOBAL lane ids with the same base key
+      on every shard (the mesh is an execution detail);
+    - per-shard work queues for ``eval_mode="episodes_refill"``
+      (``seed_stride`` is forced to the global popsize so (solution, episode)
+      seeds stay unique across shards, and ``refill_width`` is GLOBAL —
+      divided across the mesh like every other surface of the knob
+      (``VecNE`` ``refill_config['width']``, ``BENCH_REFILL_WIDTH``) —
+      so the same value means the same total lane count at any mesh size.
+      This helper is the strict surface: it raises on a width not divisible
+      by the mesh axis size, while the convenience knobs floor per shard
+      like compact_config's widths);
+    - obs-norm statistics merged with a psum — per-step deltas when
+      ``stats_sync=True`` (mesh-global cohort), else one end-of-rollout delta
+      merge (shard-local cohorts, the reference's per-actor semantics);
+    - step/episode counters psum'd, per-shard counted steps returned.
+
+    Accepts dense ``(N, L)`` populations and factored
+    ``LowRankParamsBatch``es (coefficients shard; center/basis replicate).
+    Returns ``evaluator(values, key, stats) -> (RolloutResult,
+    per_shard_steps)``.
+    """
+    # imported lazily: parallel.* must stay importable before neuroevolution
+    from ..neuroevolution.net.vecrl import (
+        _params_popsize,
+        _params_shard_spec,
+        global_lane_ids,
+        run_vectorized_rollout,
+        RolloutResult,
+    )
+    from ..tools.lowrank import LowRankParamsBatch
+
+    reserved = {"lane_ids", "stats_sync_axis", "seed_stride"} & set(rollout_kwargs)
+    if reserved:
+        raise ValueError(
+            f"make_sharded_rollout_evaluator sets {sorted(reserved)} itself "
+            "(global lane ids, the stats_sync/axis wiring, and the global "
+            "seed stride are what the helper exists to get right) — drop "
+            "them from the rollout kwargs"
+        )
+    if mesh is None:
+        mesh = default_mesh((axis_name,))
+    if rollout_kwargs.get("refill_width") is not None:
+        width = int(rollout_kwargs["refill_width"])
+        n_shards = mesh.shape[axis_name]
+        if width % n_shards != 0:
+            raise ValueError(
+                f"refill_width={width} is global and must be divisible by "
+                f"the mesh axis size {n_shards}"
+            )
+        rollout_kwargs["refill_width"] = width // n_shards
+
+    def build(lowrank: bool, popsize: int):
+        def local(values_shard, key, stats):
+            result = run_vectorized_rollout(
+                env,
+                policy,
+                values_shard,
+                key,
+                stats,
+                lane_ids=global_lane_ids(axis_name, _params_popsize(values_shard)),
+                stats_sync_axis=axis_name if stats_sync else None,
+                seed_stride=popsize,
+                **rollout_kwargs,
+            )
+            if stats_sync:
+                merged = result.stats  # per-step psums already mesh-global
+            else:
+                delta = jax.tree_util.tree_map(
+                    lambda new, old: new - old, result.stats, stats
+                )
+                merged = jax.tree_util.tree_map(
+                    lambda old, d: old + jax.lax.psum(d, axis_name), stats, delta
+                )
+            return (
+                result.scores,
+                merged,
+                jax.lax.psum(result.total_steps, axis_name),
+                jax.lax.psum(result.total_episodes, axis_name),
+                result.total_steps[None],
+            )
+
+        values_spec = _params_shard_spec(lowrank, axis_name)
+        return jax.jit(
+            jax.shard_map(
+                local,
+                mesh=mesh,
+                in_specs=(values_spec, P(), P()),
+                out_specs=(P(axis_name), P(), P(), P(), P(axis_name)),
+                check_vma=False,
+            )
+        )
+
+    # bounded LRU like vecrl's engine caches: an adaptive-popsize caller
+    # compiles one shard_map program per distinct popsize, and compiled
+    # executables must not accumulate without bound over a long run
+    build = functools.lru_cache(maxsize=_EVALUATOR_CACHE_SIZE)(build)
+
+    def evaluator(values, key, stats):
+        lowrank = isinstance(values, LowRankParamsBatch)
+        popsize = _params_popsize(values)
+        fn = build(lowrank, popsize)
+        scores, merged, steps, episodes, per_shard = fn(values, key, stats)
+        result = RolloutResult(
+            scores=scores, stats=merged, total_steps=steps, total_episodes=episodes
+        )
+        return result, per_shard
 
     return evaluator

@@ -88,15 +88,24 @@ def make_sharded_grad_estimator(
             )
             if with_aux:
                 aux = {"mean_eval": jax.lax.pmean(jnp.mean(fitnesses), axis_name)}
+                if lowrank_rank is not None:
+                    # each shard's basis rides out stacked along the pop axis
+                    # (shard i's rows at [i*L:(i+1)*L]) so the caller can run
+                    # the subspace-exhaustion diagnostic on a representative
+                    # per-shard basis without an extra collective
+                    aux["basis"] = samples.basis
                 return out, aux
             return out
 
+        aux_specs = {"mean_eval": P()}
+        if lowrank_rank is not None:
+            aux_specs["basis"] = P(axis_name)
         return jax.jit(
             jax.shard_map(
                 local,
                 mesh=mesh,
                 in_specs=(P(), P()),
-                out_specs=P(),
+                out_specs=(P(), aux_specs) if with_aux else P(),
                 check_vma=False,
             )
         )

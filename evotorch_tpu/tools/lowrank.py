@@ -24,7 +24,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-__all__ = ["LowRankParamsBatch", "dense_values"]
+__all__ = ["LowRankParamsBatch", "basis_capture", "dense_values"]
 
 
 class LowRankParamsBatch(NamedTuple):
@@ -62,6 +62,33 @@ class LowRankParamsBatch(NamedTuple):
         ``(K, L)`` — for cheaply extracting a handful of winners without
         building the full population."""
         return self.center + coeff_rows @ self.basis.T
+
+
+def basis_capture(basis: jnp.ndarray, vector: jnp.ndarray) -> jnp.ndarray:
+    """Fraction of ``vector``'s norm captured by ``span(basis)``:
+    ``||P_B v|| / ||v||`` in ``[0, 1]`` (returns 1.0 for a zero vector).
+
+    The subspace-exhaustion diagnostic of factored search: a rank-``k``
+    random basis in ``L`` dimensions captures ~``sqrt(k/L)`` of ANY fixed
+    direction in expectation — every per-generation gradient estimate is
+    confined to its basis's span, so when the (accumulated) dense gradient
+    direction's capture stays far below ~1, most of the signal the dense
+    estimator would follow is simply not expressible and progress stalls
+    (measured: the HalfCheetah rank-32 stall,
+    ``bench_curves/halfcheetah_lowrank_cpu_r5.jsonl``). Cost: one ``k x k``
+    solve — O(L k^2).
+    """
+    v_sq = jnp.sum(vector * vector)
+    gram = basis.T @ basis  # (k, k)
+    proj = basis.T @ vector  # (k,)
+    # ridge-regularized normal equations: the basis columns are random and
+    # can be near-collinear at high rank
+    eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
+    ridge = 1e-12 * jnp.maximum(jnp.trace(gram), 1e-30)
+    coef = jnp.linalg.solve(gram + ridge * eye, proj)
+    captured_sq = jnp.clip(proj @ coef, 0.0, None)
+    frac = jnp.sqrt(captured_sq / jnp.maximum(v_sq, 1e-30))
+    return jnp.where(v_sq > 0, jnp.clip(frac, 0.0, 1.0), jnp.asarray(1.0, frac.dtype))
 
 
 def dense_values(values):

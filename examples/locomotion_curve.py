@@ -160,6 +160,10 @@ def main():
             }
             if args.num_interactions is not None:
                 row["popsize"] = int(searcher.status["popsize"])
+            if args.lowrank_rank is not None:
+                # subspace-exhaustion diagnostic (tools.lowrank.basis_capture):
+                # persistently << 1 at a stalling rank (the rank-32 curve)
+                row["basis_capture"] = searcher.status.get("basis_capture")
             if gen % args.eval_every == 0 or gen == args.generations:
                 center_scores = eval_center()
                 row["center_full"] = center_scores.get("full")

@@ -1,0 +1,79 @@
+"""Examples smoke tier (mirrors reference ``tests/test_examples.py``): run
+every ``examples/*.py`` end-to-end on the CPU backend at minimal sizes, so
+doc rot in the examples becomes detectable instead of silently accumulating.
+
+Each script runs in a subprocess (its own backend setup — the examples pick
+their platform before first device use) with ``cwd`` in a temp directory, so
+artifacts the examples write (solution pickles, curve JSONLs) never land in
+the repo. ``rl_enjoy`` consumes the pickle ``rl_clipup`` saves, so the two
+are chained into one case.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+# script -> extra args beyond the common `--cpu --generations N` smoke knobs
+CASES = {
+    "bbo_vectorized.py": [],
+    "functional_batched_search.py": [],
+    "humanoid_pgpe.py": [],
+    "locomotion_curve.py": [
+        "--env", "hopper", "--popsize", "8", "--episode-length", "5",
+        "--eval-every", "1", "--eval-episodes", "2",
+    ],
+    "mapelites_illumination.py": [],
+    "moo_pareto.py": [],
+    "mpc_cem.py": [],
+    "object_dtype_ga.py": [],
+    "rl_clipup.py": [],  # + rl_enjoy on its saved solution, below
+    "wide_policy_lowrank.py": [],
+}
+
+
+def _run_example(script, extra, cwd, generations="2"):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES_DIR, script), "--cpu",
+         "--generations", generations, *extra],
+        cwd=cwd,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed (rc={proc.returncode})\n"
+        f"--- stdout ---\n{proc.stdout[-4000:]}\n"
+        f"--- stderr ---\n{proc.stderr[-4000:]}"
+    )
+    return proc
+
+
+def test_examples_directory_is_covered():
+    # a new example must either join CASES or be excluded here on purpose
+    scripts = {
+        f for f in os.listdir(EXAMPLES_DIR)
+        if f.endswith(".py") and not f.startswith("_")
+    }
+    assert scripts == set(CASES) | {"rl_enjoy.py"}, scripts
+
+
+@pytest.mark.parametrize("script", sorted(CASES))
+def test_example_smoke(script, tmp_path):
+    _run_example(script, CASES[script], str(tmp_path))
+    if script == "rl_clipup.py":
+        # the companion example: replay the solution rl_clipup just saved
+        assert (tmp_path / "rl_clipup_solution.pkl").exists()
+        proc = _run_example(
+            "rl_enjoy.py", ["--solution", "rl_clipup_solution.pkl"], str(tmp_path)
+        )
+        assert "episodic return" in proc.stdout

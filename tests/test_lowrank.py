@@ -228,6 +228,88 @@ def test_compacting_rollout_accepts_lowrank():
     )
 
 
+def test_refill_rollout_accepts_lowrank():
+    # the lane-refill scheduler carries per-lane COEFFICIENTS only (the
+    # shared center/basis stay loop-invariant) and must agree with the
+    # monolithic episodes evaluation of the same factored population
+    env = CartPole(continuous_actions=True)
+    net = Linear(env.observation_size, 8) >> Tanh() >> Linear(8, env.action_size)
+    policy = FlatParamsPolicy(net)
+    params = _random_lowrank(policy, n=16, k=4, seed=8)
+    stats = RunningNorm(env.observation_size).stats
+    kw = dict(num_episodes=1, episode_length=80)
+    mono = run_vectorized_rollout(
+        env, policy, params, jax.random.key(2), stats, eval_mode="episodes", **kw
+    )
+    refill = run_vectorized_rollout(
+        env, policy, params, jax.random.key(2), stats,
+        eval_mode="episodes_refill", refill_width=4, **kw,
+    )
+    np.testing.assert_array_equal(np.asarray(refill.scores), np.asarray(mono.scores))
+    assert int(refill.total_steps) == int(mono.total_steps)
+
+
+# -- basis_capture: the subspace-exhaustion guardrail --------------------------
+
+
+def test_basis_capture_math():
+    from evotorch_tpu.tools.lowrank import basis_capture
+
+    L, k = 2000, 16
+    basis = jax.random.normal(jax.random.key(0), (L, k))
+    # a random direction: captured fraction concentrates around sqrt(k/L)
+    v = jax.random.normal(jax.random.key(1), (L,))
+    cap = float(basis_capture(basis, v))
+    expected = (k / L) ** 0.5
+    assert 0.2 * expected < cap < 5 * expected
+    # an in-span vector is fully captured; the zero vector reports 1.0
+    v_in = basis @ jax.random.normal(jax.random.key(2), (k,))
+    assert float(basis_capture(basis, v_in)) > 0.999
+    assert float(basis_capture(basis, jnp.zeros(L))) == 1.0
+
+
+@pytest.mark.slow
+def test_lowrank_rank32_halfcheetah_exhaustion_warns():
+    """Miniature of the HalfCheetah rank-32 stall
+    (bench_curves/halfcheetah_lowrank_cpu_r5.jsonl: rank 32 plateaus at ~470
+    while rank 64 and dense reach ~1050): at the stalling configuration's
+    rank/L ratio the per-generation basis captures well under 10% of the
+    accumulated gradient direction, and the subspace-exhaustion guardrail
+    must both report it (status basis_capture) and warn."""
+    import warnings
+
+    from evotorch_tpu.algorithms import PGPE
+    from evotorch_tpu.neuroevolution import VecNE
+
+    problem = VecNE(
+        "halfcheetah",
+        # the curve's network shape: rank 32 against L ~ 8.6k
+        "Linear(obs_length, 64) >> Tanh() >> Linear(64, 64) >> Tanh()"
+        " >> Linear(64, act_length)",
+        episode_length=5,
+        seed=0,
+    )
+    searcher = PGPE(
+        problem,
+        popsize=8,
+        center_learning_rate=0.05,
+        stdev_learning_rate=0.1,
+        stdev_init=0.1,
+        lowrank_rank=32,
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for _ in range(7):
+            searcher.step()
+    capture = searcher.status["basis_capture"]
+    assert capture is not None and capture < 0.1
+    exhaustion = [
+        w for w in caught if "subspace exhaustion" in str(w.message)
+    ]
+    assert len(exhaustion) == 1  # fires once, not every generation
+    assert "rank-32" in str(exhaustion[0].message)
+
+
 def test_pgpe_lowrank_tell_matches_dense_tell():
     # the factored gradient math must equal pgpe_tell on the materialized
     # population exactly (same optimizer state, same stdev update)
@@ -519,6 +601,7 @@ def test_factored_cat_rejects_mismatched_basis_and_mixed():
         SolutionBatch.cat([SolutionBatch(problem, values=a), dense])
 
 
+@pytest.mark.slow
 def test_oo_pgpe_lowrank_adaptive_popsize_vecne():
     # the reference's flagship recipe shape (popsize -> popsize_max under an
     # interaction budget, rl_clipup.py:184-191) running factored end-to-end:
@@ -571,6 +654,34 @@ def test_oo_pgpe_lowrank_distributed_improves_sphere():
     )
     searcher.run(40)
     assert float(searcher.status["mean_eval"]) < 30.0  # from ~9*30 initially
+
+
+def test_oo_pgpe_lowrank_distributed_reports_basis_capture():
+    # the subspace-exhaustion guardrail must also cover the distributed
+    # step path (both the single-program fallback and the sharded
+    # estimator surface the generation's basis in the gradient results)
+    from evotorch_tpu.algorithms import PGPE
+    from evotorch_tpu.parallel import default_mesh
+
+    for sharded in (False, True):
+        problem = _sphere_problem()
+        if sharded:
+            problem.use_sharded_evaluation(default_mesh(("pop",)))
+        searcher = PGPE(
+            problem,
+            popsize=64,
+            center_learning_rate=0.1,
+            stdev_learning_rate=0.1,
+            stdev_init=0.5,
+            distributed=True,
+            lowrank_rank=8,
+        )
+        # capture compares the basis against the PREVIOUS generations'
+        # gradient-direction EMA, so it needs at least two steps
+        searcher.run(3)
+        capture = searcher.status["basis_capture"]
+        assert capture is not None, f"sharded={sharded}"
+        assert 0.0 <= float(capture) <= 1.0
 
 
 def test_oo_pgpe_lowrank_distributed_adaptive_vecne():

@@ -111,6 +111,7 @@ def test_sharded_grad_estimator_converges():
     assert float(jnp.linalg.norm(mu)) < 1.0
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_various_topologies(monkeypatch):
     import __graft_entry__ as g
 
@@ -144,6 +145,7 @@ def test_sharded_evaluator_multi_output():
     assert fit13.shape == (13,) and extra13.shape == (13, 2)
 
 
+@pytest.mark.slow
 def test_sharded_training_identical_across_topologies():
     """3 PGPE generations on the flagship Humanoid with the population
     sharded over pop x model meshes 8x1 / 4x2 / 2x4: the mesh topology is an
@@ -207,6 +209,7 @@ def test_sharded_training_identical_across_topologies():
     np.testing.assert_allclose(scores_24, scores_81, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_sharded_lowrank_obsnorm_identical_across_topologies():
     """VERDICT r4 #8: the two newest representations — factored (low-rank)
     populations and observation normalization — exercised TOGETHER under

@@ -532,6 +532,7 @@ def test_sharded_compacting_lowrank():
 # -- per-lane PRNG chains: randomness as a per-lane property ------------------
 
 
+@pytest.mark.slow
 def test_compacting_bit_exact_with_noise_and_multi_episode():
     # the former caveat config: multi-episode + action noise used to be only
     # distribution-equivalent under compaction; per-lane PRNG chains make it
@@ -612,6 +613,7 @@ def test_vecne_sharded_equals_unsharded_bit_exact():
     )
 
 
+@pytest.mark.slow
 def test_vecne_sharded_obs_norm_divergence_bounded():
     # VERDICT r4 #6: with observation normalization ON, each shard normalizes
     # its lanes by shard-local cohort statistics mid-rollout (parity with the
@@ -718,6 +720,260 @@ def test_vecne_sharded_obs_norm_step_sync_matches_unsharded():
         np.asarray(p_sync._obs_norm.mean), np.asarray(p_plain._obs_norm.mean),
         rtol=1e-4, atol=1e-4,
     )
+
+
+# -- work-conserving lane-refill scheduler (episodes_refill) ------------------
+
+
+def test_refill_matches_monolithic_episodes_any_width():
+    # the core contract: matched seeds => refill scores == plain `episodes`
+    # scores BIT-FOR-BIT for every lane, at any fixed width — including a
+    # popsize that is not divisible by W (the queue handles the remainder)
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    n = 37  # deliberately not divisible by any tested width
+    rng = np.random.default_rng(0)
+    params = jnp.asarray(rng.normal(size=(n, policy.parameter_count)), jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    kw = dict(num_episodes=1, episode_length=120)
+    mono = run_vectorized_rollout(
+        env, policy, params, jax.random.key(7), stats, eval_mode="episodes", **kw
+    )
+    for width in (5, 16):
+        ref = run_vectorized_rollout(
+            env, policy, params, jax.random.key(7), stats,
+            eval_mode="episodes_refill", refill_width=width, **kw,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.scores), np.asarray(mono.scores)
+        )
+        assert int(ref.total_steps) == int(mono.total_steps)
+        assert int(ref.total_episodes) == n
+
+
+def test_refill_accepts_legacy_uint32_key():
+    # a legacy raw uint32 PRNGKey must work (the monolithic engine accepts
+    # it, and the refill engine wraps it into a typed key array so the
+    # lane-select jnp.where stays rank-1) and keep matched-seed bit-identity
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    rng = np.random.default_rng(5)
+    params = jnp.asarray(rng.normal(size=(11, policy.parameter_count)), jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    kw = dict(num_episodes=1, episode_length=60)
+    mono = run_vectorized_rollout(
+        env, policy, params, jax.random.PRNGKey(2), stats,
+        eval_mode="episodes", **kw,
+    )
+    ref = run_vectorized_rollout(
+        env, policy, params, jax.random.PRNGKey(2), stats,
+        eval_mode="episodes_refill", refill_width=4, **kw,
+    )
+    np.testing.assert_array_equal(np.asarray(ref.scores), np.asarray(mono.scores))
+
+
+def test_refill_bit_exact_with_action_noise():
+    # refill lanes carry the same per-lane PRNG chains (3-way split per step)
+    # as the monolithic engine, so even the noise draws match draw-for-draw
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    rng = np.random.default_rng(9)
+    params = jnp.asarray(rng.normal(size=(16, policy.parameter_count)), jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    kw = dict(num_episodes=1, episode_length=60, action_noise_stdev=0.1)
+    mono = run_vectorized_rollout(
+        env, policy, params, jax.random.key(3), stats, eval_mode="episodes", **kw
+    )
+    ref = run_vectorized_rollout(
+        env, policy, params, jax.random.key(3), stats,
+        eval_mode="episodes_refill", refill_width=6, **kw,
+    )
+    np.testing.assert_array_equal(np.asarray(ref.scores), np.asarray(mono.scores))
+
+
+def test_refill_obs_norm_counts_only_live_lane_steps():
+    # the step-count invariant: every counted interaction contributes exactly
+    # one observation to the running statistics — idle (finished, waiting)
+    # and drained lanes contribute nothing, refilled lanes contribute their
+    # fresh reset observation
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    rng = np.random.default_rng(1)
+    params = jnp.asarray(rng.normal(size=(24, policy.parameter_count)), jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    for period in (1, 3):
+        ref = run_vectorized_rollout(
+            env, policy, params, jax.random.key(4), stats,
+            eval_mode="episodes_refill", refill_width=8, refill_period=period,
+            num_episodes=1, episode_length=80, observation_normalization=True,
+        )
+        assert float(ref.stats.count) == float(ref.total_steps)
+        assert int(ref.total_episodes) == 24
+        assert np.isfinite(np.asarray(ref.scores)).all()
+
+
+def test_refill_multi_episode_accounting_and_period():
+    # num_episodes > 1: every (solution, episode) item runs on its own PRNG
+    # chain (distribution-equivalent to the monolithic engine, not bit-equal)
+    # but the contract accounting must hold exactly, also with a refill
+    # period > 1 (finished lanes wait masked between refill boundaries)
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    rng = np.random.default_rng(2)
+    n = 12
+    params = jnp.asarray(rng.normal(size=(n, policy.parameter_count)), jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    ref = run_vectorized_rollout(
+        env, policy, params, jax.random.key(5), stats,
+        eval_mode="episodes_refill", refill_width=16, refill_period=4,
+        num_episodes=3, episode_length=60,
+    )
+    assert int(ref.total_episodes) == 3 * n
+    assert np.isfinite(np.asarray(ref.scores)).all()
+    assert float(jnp.min(ref.scores)) >= 1.0
+
+
+def test_refill_sharded_matches_unsharded_and_monolithic():
+    # per-shard queues under shard_map: global lane ids + a global seed
+    # stride make the sharded refill evaluation reproduce BOTH the unsharded
+    # refill one and the unsharded monolithic episodes contract bit-for-bit
+    from jax.sharding import PartitionSpec as P
+
+    from evotorch_tpu.neuroevolution.net.vecrl import global_lane_ids
+    from evotorch_tpu.parallel.mesh import default_mesh
+
+    env = CartPole(continuous_actions=True)
+    policy = _linear_policy(env)
+    n = 32
+    rng = np.random.default_rng(5)
+    params = jnp.asarray(rng.normal(size=(n, policy.parameter_count)), jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    mesh = default_mesh(("pop",))
+    kw = dict(num_episodes=1, episode_length=100)
+
+    mono = run_vectorized_rollout(
+        env, policy, params, jax.random.key(21), stats, eval_mode="episodes", **kw
+    )
+
+    def local(values_shard, key, stats):
+        r = run_vectorized_rollout(
+            env, policy, values_shard, key, stats,
+            eval_mode="episodes_refill", refill_width=2, seed_stride=n,
+            lane_ids=global_lane_ids("pop", values_shard.shape[0]), **kw,
+        )
+        return (
+            r.scores,
+            jax.lax.psum(r.total_steps, "pop"),
+            jax.lax.psum(r.total_episodes, "pop"),
+        )
+
+    scores, steps, episodes = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P("pop"), P(), P()),
+            out_specs=(P("pop"), P(), P()),
+            check_vma=False,
+        )
+    )(params, jax.random.key(21), stats)
+    np.testing.assert_array_equal(np.asarray(scores), np.asarray(mono.scores))
+    assert int(steps) == int(mono.total_steps)
+    assert int(episodes) == n
+
+
+def test_vecne_refill_eval_mode_plain_and_sharded():
+    # VecNE wiring: eval_mode="episodes_refill" with a refill_config, through
+    # both the plain and the sharded evaluation paths — scores must equal the
+    # episodes-mode problem's bit-for-bit, and the counters must agree
+    from evotorch_tpu.core import SolutionBatch
+    from evotorch_tpu.neuroevolution import VecNE
+
+    def make(mode, **extra):
+        return VecNE(
+            "cartpole",
+            "Linear(obs_length, 8) >> Tanh() >> Linear(8, act_length)",
+            env_config={"continuous_actions": True},
+            episode_length=60,
+            eval_mode=mode,
+            seed=9,
+            **extra,
+        )
+
+    p_mono = make("episodes")
+    p_ref = make("episodes_refill", refill_config={"width": 8})
+    p_ref_sh = make("episodes_refill", refill_config={"width": 8, "period": 2})
+    rng = np.random.default_rng(7)
+    values = jnp.asarray(
+        rng.normal(size=(24, p_mono.solution_length)) * 0.3, jnp.float32
+    )
+    b_mono = SolutionBatch(p_mono, values=values)
+    b_ref = SolutionBatch(p_ref, values=values)
+    b_sh = SolutionBatch(p_ref_sh, values=values)
+    p_mono.evaluate(b_mono)
+    p_ref.evaluate(b_ref)
+    p_ref_sh.evaluate_sharded(b_sh)
+    np.testing.assert_array_equal(
+        np.asarray(b_ref.evals_of(0)), np.asarray(b_mono.evals_of(0))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b_sh.evals_of(0)), np.asarray(b_mono.evals_of(0))
+    )
+    assert int(p_ref.status["total_episode_count"]) == 24
+    assert int(p_ref.status["total_interaction_count"]) == int(
+        p_mono.status["total_interaction_count"]
+    )
+
+
+def test_refill_nonzero_initial_policy_state_bit_exact():
+    # refilled lanes must start their episode from policy.initial_state(),
+    # NOT zeros: with a stateful module whose initial state is nonzero, a
+    # solution evaluated in a refilled lane (any solution beyond the first
+    # W) would otherwise diverge from the monolithic episodes evaluation
+    from evotorch_tpu.neuroevolution.net.layers import Module
+
+    class BiasedStateCell(Module):
+        """Minimal stateful cell with a NONZERO initial state."""
+
+        hidden = 4
+
+        def init(self, key):
+            return {"w": 0.1 * jnp.ones((self.hidden, 3))}
+
+        def initial_state(self):
+            return jnp.ones(self.hidden)  # deliberately not zeros
+
+        def apply(self, params, x, state=None):
+            if state is None:
+                state = jnp.ones(x.shape[:-1] + (self.hidden,), dtype=x.dtype)
+            h = jnp.tanh(x @ params["w"].T + state)
+            return h, h
+
+    env = Pendulum()
+    net = BiasedStateCell() >> Linear(4, env.action_size)
+    policy = FlatParamsPolicy(net)
+    n = 12
+    params = jax.vmap(policy.init_parameters)(jax.random.split(jax.random.key(0), n))
+    stats = RunningNorm(env.observation_size).stats
+    kw = dict(num_episodes=1, episode_length=25)
+    mono = run_vectorized_rollout(
+        env, policy, params, jax.random.key(1), stats, eval_mode="episodes", **kw
+    )
+    ref = run_vectorized_rollout(
+        env, policy, params, jax.random.key(1), stats,
+        eval_mode="episodes_refill", refill_width=3, **kw,
+    )
+    np.testing.assert_array_equal(np.asarray(ref.scores), np.asarray(mono.scores))
+
+
+def test_refill_invalid_mode_still_rejected():
+    env = Pendulum()
+    policy = _linear_policy(env)
+    params = jnp.zeros((2, policy.parameter_count))
+    stats = RunningNorm(env.observation_size).stats
+    with pytest.raises(ValueError, match="eval_mode"):
+        run_vectorized_rollout(
+            env, policy, params, jax.random.key(0), stats, eval_mode="refill"
+        )
 
 
 def test_sharded_compacting_obs_norm_step_sync():
