@@ -11,6 +11,13 @@ jitted while_loop/scan").
 ``make_env("cartpole")`` returns such an env; ``"brax::<name>"`` adapts a
 brax env when brax is installed (import-gated), mirroring the reference's
 ``"gym::"``/``"brax::"`` registry strings (``vecgymne.py:496-570``).
+
+The ``mujoco`` subpackage (``envs/mujoco/``, import-gated on the optional
+``mujoco`` + ``gymnasium`` packages) is the REAL-physics counterpart: a
+batched host rollout engine over real gymnasium ``-v5`` models
+(``MjVecEnv``) and the matched-action fidelity harness that measures how
+far these native envs diverge from their MuJoCo namesakes
+(``docs/neuroevolution.md``).
 """
 
 from .base import Env, EnvState, Space

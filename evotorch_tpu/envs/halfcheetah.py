@@ -120,3 +120,17 @@ class HalfCheetah(RigidBodyLocomotionEnv):
         reward = self.forward_reward_weight * forward_vel - ctrl_cost
         done = t >= self.max_episode_steps
         return reward, done
+
+    def batch_reward_terms(self, st, actions_minor):
+        """No alive bonus and no healthy band (HalfCheetah-v5 semantics):
+        the survive term is identically zero and every state is healthy."""
+        B = st.pos.shape[-1]
+        forward_vel = st.vel[0, 0, :]
+        ctrl_cost = self.ctrl_cost_weight * jnp.sum(actions_minor * actions_minor, axis=0)
+        return {
+            "x_velocity": forward_vel,
+            "reward_forward": self.forward_reward_weight * forward_vel,
+            "reward_ctrl": -ctrl_cost,
+            "reward_survive": jnp.zeros(B),
+            "healthy": jnp.ones(B, dtype=bool),
+        }

@@ -154,6 +154,28 @@ class RigidBodyLocomotionEnv(Env):
         reward = jnp.where(unhealthy, reward - self.alive_bonus, reward)
         return reward, done
 
+    def batch_reward_terms(self, st: BodyState, actions_minor: jnp.ndarray):
+        """Per-term decomposition of the step reward — the same quantities
+        gymnasium's ``-v5`` envs expose in ``info`` (``reward_forward`` /
+        ``reward_ctrl`` / ``reward_survive``), so the env-fidelity harness
+        (``envs/mujoco/fidelity.py``) can compare the native simulator and
+        the real env term by term. ``actions_minor`` is ``(na, B)``; returns
+        a dict of ``(B,)`` arrays whose signed sum
+        (``reward_forward + reward_ctrl + reward_survive``) equals the
+        reward returned by :meth:`batch_step`."""
+        z = st.pos[0, 2, :]
+        lo, hi = self.healthy_z_range
+        healthy = (z >= lo) & (z <= hi)
+        forward_vel = st.vel[0, 0, :]
+        ctrl_cost = self.ctrl_cost_weight * jnp.sum(actions_minor * actions_minor, axis=0)
+        return {
+            "x_velocity": forward_vel,
+            "reward_forward": self.forward_reward_weight * forward_vel,
+            "reward_ctrl": -ctrl_cost,
+            "reward_survive": self.alive_bonus * healthy,
+            "healthy": healthy,
+        }
+
     # -- batched-native protocol ---------------------------------------------
     def batch_reset(self, keys):
         """Reset ``B`` lanes at once; ``keys`` is a ``(B,)`` key array."""

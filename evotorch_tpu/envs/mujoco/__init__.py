@@ -1,0 +1,60 @@
+"""Real-MuJoCo evaluation backend.
+
+The pure-JAX envs in ``evotorch_tpu.envs`` are this framework's TPU-native
+throughput substrate; this subpackage grounds them in the *canonical*
+benchmark: real gymnasium ``-v5`` MuJoCo locomotion. It provides
+
+- :class:`MjVecEnv` (``mjvecenv.py``) — a batched host rollout engine that
+  steps N real MuJoCo models per call through ``mujoco.rollout``'s threaded
+  API, recomputing each ``-v5`` family's observation / reward terms /
+  termination from raw physics state so the per-term decomposition
+  (forward velocity, control cost, healthy bonus) is available every step.
+  API-compatible with ``net.hostvecenv.SyncVectorEnv``, so the batched
+  policy-forward evaluation loop (one device call per timestep for the whole
+  lane block) works unchanged on real physics.
+- :func:`make_host_vector_env` — the backend chooser ``GymNE`` uses when
+  ``num_envs > 1``: ``MjVecEnv`` for supported MuJoCo envs, the generic
+  gymnasium ``SyncVectorEnv`` for everything else.
+- ``fidelity.py`` — a matched-action parity harness that drives a native
+  rigid-body env and its real ``-v5`` counterpart with identical action
+  sequences and reports per-reward-term divergence (the measured statement
+  behind every "Hopper/HalfCheetah/... semantics" docstring claim).
+
+``mujoco`` (3.4.0 in this image) and ``gymnasium`` are OPTIONAL dependencies
+of the wider package: importing ``evotorch_tpu.envs.mujoco`` itself is always
+safe; the submodules import ``mujoco`` at their top level and are loaded
+lazily, so the guard is :func:`mujoco_available` (or catching ``ImportError``
+around the lazy attribute access).
+"""
+
+from __future__ import annotations
+
+from importlib import import_module, util
+
+__all__ = [
+    "MjVecEnv",
+    "make_host_vector_env",
+    "mujoco_available",
+    "run_fidelity",
+    "format_fidelity_markdown",
+]
+
+_LAZY = {
+    "MjVecEnv": ".mjvecenv",
+    "make_host_vector_env": ".mjvecenv",
+    "run_fidelity": ".fidelity",
+    "format_fidelity_markdown": ".fidelity",
+}
+
+
+def mujoco_available() -> bool:
+    """True when both ``mujoco`` and ``gymnasium`` are importable (cheap:
+    spec lookup only, no module import)."""
+    return util.find_spec("mujoco") is not None and util.find_spec("gymnasium") is not None
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(import_module(target, __name__), name)

@@ -12,7 +12,11 @@ actor delta-sync becomes a local update here; multi-device users should use
 
 This class is deliberately host-side: it exists for parity with gym-API
 environments and for debugging policies; the TPU-native throughput path is
-``VecNE`` over pure-JAX envs.
+``VecNE`` over pure-JAX envs. With ``num_envs > 1`` the evaluation becomes
+lane-vectorized (one batched device forward per timestep), and for real
+MuJoCo ``-v5`` envs the lanes are stepped by the batched
+``envs.mujoco.MjVecEnv`` engine over ``mujoco.rollout``'s threaded API —
+the Podracer split (batched host physics feeding a device-side policy).
 """
 
 from __future__ import annotations
@@ -50,6 +54,7 @@ class GymNE(NEProblem):
         initial_bounds=(-0.00001, 0.00001),
         seed: Optional[int] = None,
         num_actors=None,
+        vector_env_backend: str = "auto",
         **kwargs,
     ):
         if env is None and env_name is None:
@@ -66,11 +71,21 @@ class GymNE(NEProblem):
         self._obs_stats = RunningStat()
         self._interaction_count = 0
         self._episode_count = 0
-        # num_envs > 1 turns on in-process vectorized evaluation: a
-        # SyncVectorEnv steps num_envs gym envs in lockstep with ONE batched
-        # device forward per timestep (the reference's VecGymNE-over-"gym::"
-        # path, vecgymne.py:744-916 + vecrl.py:1541-1912)
+        # num_envs > 1 turns on in-process vectorized evaluation: num_envs env
+        # lanes stepped in lockstep with ONE batched device forward per
+        # timestep (the reference's VecGymNE-over-"gym::" path,
+        # vecgymne.py:744-916 + vecrl.py:1541-1912). The lane engine is
+        # chosen by vector_env_backend: "auto" picks the real-MuJoCo batched
+        # engine (envs.mujoco.MjVecEnv over mujoco.rollout's threaded API)
+        # when the env is a supported -v5 family, else the generic
+        # SyncVectorEnv; "mujoco"/"sync" force one or the other.
         self._num_envs = None if num_envs is None else int(num_envs)
+        self._vector_env_backend = str(vector_env_backend)
+        if self._vector_env_backend not in ("auto", "mujoco", "sync"):
+            raise ValueError(
+                "vector_env_backend must be 'auto', 'mujoco' or 'sync',"
+                f" got {vector_env_backend!r}"
+            )
         self._vec_env = None
 
         self._make_gym_env()  # early, so network constants are available
@@ -200,6 +215,20 @@ class GymNE(NEProblem):
     def _make_vector_env(self):
         if self._vec_env is not None:
             return self._vec_env
+        backend = self._vector_env_backend
+        if backend in ("auto", "mujoco"):
+            try:
+                from ..envs.mujoco import make_host_vector_env
+                from ..envs.mujoco.mjvecenv import MjVecEnv
+
+                if backend == "mujoco":
+                    self._vec_env = MjVecEnv(self._build_one_env, self._num_envs)
+                else:
+                    self._vec_env = make_host_vector_env(self._build_one_env, self._num_envs)
+                return self._vec_env
+            except ImportError:
+                if backend == "mujoco":
+                    raise  # explicitly requested; don't silently degrade
         from .net.hostvecenv import SyncVectorEnv
 
         self._vec_env = SyncVectorEnv(self._build_one_env, self._num_envs)

@@ -152,12 +152,19 @@ def main():
     with open(out_path, "a") as f:
         for gen in range(1, args.generations + 1):
             searcher.step()
+            opt = searcher.optimizer
             row = {
                 "gen": gen,
                 "mean_eval": float(searcher.status["mean_eval"]),
                 "best_eval": float(searcher.status["best_eval"]),
+                # plateau diagnostics (VERDICT r5 weak #4): a collapsing
+                # stdev norm = premature convergence; a pinned ClipUp
+                # velocity norm (== max_speed) = step-size ceiling
+                "stdev_norm": float(jnp.linalg.norm(searcher.status["stdev"])),
                 "elapsed_s": round(time.time() - t_start, 1),
             }
+            if hasattr(opt, "_velocity"):
+                row["clipup_velocity_norm"] = float(jnp.linalg.norm(opt._velocity))
             if args.num_interactions is not None:
                 row["popsize"] = int(searcher.status["popsize"])
             if args.lowrank_rank is not None:
@@ -168,7 +175,13 @@ def main():
                 center_scores = eval_center()
                 row["center_full"] = center_scores.get("full")
                 if "no_alive_bonus" in center_scores:
+                    # the velocity/bonus reward split: no_alive_bonus IS the
+                    # velocity term (locomotion = velocity - ctrl cost); the
+                    # bonus term is the survival plateau's share of the score
                     row["center_no_alive_bonus"] = center_scores["no_alive_bonus"]
+                    row["center_bonus_term"] = (
+                        center_scores["full"] - center_scores["no_alive_bonus"]
+                    )
                 print(json.dumps(row), flush=True)
             f.write(json.dumps(row) + "\n")
             f.flush()

@@ -17,9 +17,21 @@ DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-11} * 3600 ))
 stamp() { date -u +%FT%TZ; }
 echo "$(stamp) watcher armed (pid $$, probe every ${PROBE_SECONDS}s)" >> "$LOG"
 
+# the probe must see a NON-CPU backend: on 2026-08-04 the axon plugin
+# stopped pinning the platform and jax fell back to CPU, so the bare
+# "import jax; jax.devices()" probe false-fired the battery onto the 1-core
+# CPU (cpu-fallback JSON + bogus .ok stamps, quarantined in
+# bench_curves/tpu_r5/false_fire_cpu_r6/). A dead tunnel still hangs the
+# probe (timeout -> unhealthy); a CPU fallback now fails the assert.
+probe_tpu() {
+  timeout 40 python -c \
+    "import jax; ds=jax.devices(); assert ds and ds[0].platform != 'cpu', ds; print(ds)" \
+    >/dev/null 2>&1
+}
+
 healthy_fails=0  # consecutive battery failures with the tunnel still healthy
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  if timeout 40 python -c "import jax; print(jax.devices())" >/dev/null 2>&1; then
+  if probe_tpu; then
     echo "$(stamp) tunnel HEALTHY — firing battery" >> "$LOG"
     bash scripts/tpu_window.sh >> "$LOG" 2>&1
     rc=$?

@@ -14,7 +14,12 @@ OUT=bench_curves/tpu_r5
 mkdir -p "$OUT"
 
 probe() {
-  timeout 40 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+  # require a NON-CPU backend: a bare jax.devices() probe false-fires when
+  # the axon plugin silently falls back to CPU (seen 2026-08-04; the whole
+  # battery ran on the 1-core CPU and stamped bogus .ok files)
+  timeout 40 python -c \
+    "import jax; ds=jax.devices(); assert ds and ds[0].platform != 'cpu', ds; print(ds)" \
+    >/dev/null 2>&1
 }
 
 STEPS=()

@@ -1,4 +1,6 @@
-"""Test-session configuration: deterministic seeds (reference tests/conftest.py:21-27)."""
+"""Test-session configuration: deterministic seeds (reference tests/conftest.py:21-27)
+and the ``mujoco`` marker guard (real-MuJoCo tests skip cleanly where the
+optional mujoco/gymnasium packages are absent)."""
 
 import numpy as np
 import pytest
@@ -8,3 +10,16 @@ import pytest
 def _seed_numpy():
     np.random.seed(0)
     yield
+
+
+def pytest_collection_modifyitems(config, items):
+    # same check as evotorch_tpu.envs.mujoco.mujoco_available, inlined so
+    # collection never pays the full package import
+    from importlib import util
+
+    if util.find_spec("mujoco") is not None and util.find_spec("gymnasium") is not None:
+        return
+    skip = pytest.mark.skip(reason="mujoco/gymnasium not installed")
+    for item in items:
+        if "mujoco" in item.keywords:
+            item.add_marker(skip)

@@ -31,6 +31,10 @@ CASES = {
     "mapelites_illumination.py": [],
     "moo_pareto.py": [],
     "mpc_cem.py": [],
+    "mujoco_curve.py": [  # real-MuJoCo backend; skipped where mujoco is absent
+        "--env", "InvertedPendulum-v5", "--popsize", "6", "--num-envs", "4",
+        "--episode-length", "20", "--eval-every", "1", "--eval-episodes", "1",
+    ],
     "object_dtype_ga.py": [],
     "rl_clipup.py": [],  # + rl_enjoy on its saved solution, below
     "wide_policy_lowrank.py": [],
@@ -69,6 +73,8 @@ def test_examples_directory_is_covered():
 
 @pytest.mark.parametrize("script", sorted(CASES))
 def test_example_smoke(script, tmp_path):
+    if script == "mujoco_curve.py":
+        pytest.importorskip("mujoco")
     _run_example(script, CASES[script], str(tmp_path))
     if script == "rl_clipup.py":
         # the companion example: replay the solution rl_clipup just saved

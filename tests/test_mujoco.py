@@ -1,0 +1,246 @@
+"""Real-MuJoCo evaluation backend (``envs/mujoco/``).
+
+Three layers of grounding, all against the *installed* mujoco + gymnasium
+(never fakes): the batched ``MjVecEnv`` engine reproduces single-env
+gymnasium stepping (observations, rewards, terminations); ``GymNE`` over a
+real ``-v5`` env runs through both the vectorized lane path and the
+``num_actors`` host pool with obs-norm delta sync; and the env-fidelity
+harness emits a structurally complete report. The native-env reward-term
+decomposition test at the bottom is pure JAX (fast tier).
+
+Horizon note: MuJoCo locomotion dynamics are chaotic — any driver that does
+not carry ``qacc_warmstart`` bit-exactly diverges from gymnasium's stepping
+at the Lyapunov rate from an initial ~1e-12 (solver-tolerance) difference.
+Per-step transitions are identical to ~1e-12; trajectory-level assertions
+therefore use a short horizon for the non-terminating chaotic env
+(HalfCheetah) and full episodes for the stiff/terminating ones (measured:
+Hopper/Walker2d/InvertedPendulum/Swimmer track to float32 precision over
+entire episodes).
+"""
+
+import numpy as np
+import pytest
+
+mujoco_mark = [pytest.mark.slow, pytest.mark.mujoco]
+
+
+def _make_pair(env_id, n):
+    import gymnasium as gym
+
+    from evotorch_tpu.envs.mujoco.mjvecenv import MjVecEnv
+
+    venv = MjVecEnv(lambda: gym.make(env_id), n)
+    refs = [gym.make(env_id) for _ in range(n)]
+    venv.seed(range(100, 100 + n))
+    obs_v = venv.reset()
+    obs_r = []
+    for i, e in enumerate(refs):
+        e.reset(seed=100 + i)  # prime the lane RNG exactly like venv.seed
+        o, _ = e.reset()
+        obs_r.append(o)
+    return venv, refs, obs_v, np.stack(obs_r)
+
+
+@pytest.mark.parametrize(
+    "env_id,horizon,atol",
+    [
+        ("Hopper-v5", 300, 1e-5),
+        ("Walker2d-v5", 300, 1e-5),
+        ("InvertedPendulum-v5", 300, 1e-5),
+        ("Swimmer-v5", 60, 1e-5),
+        # chaotic + non-terminating: per-step fidelity is ~1e-12 but
+        # trajectories diverge at the Lyapunov rate (module docstring)
+        ("HalfCheetah-v5", 40, 1e-3),
+    ],
+)
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_mjvecenv_matches_single_env_gymnasium_stepping(env_id, horizon, atol):
+    n = 3
+    venv, refs, obs_v, obs_r = _make_pair(env_id, n)
+    try:
+        np.testing.assert_allclose(obs_v, obs_r, atol=1e-6)
+        rng = np.random.default_rng(7)
+        done_r = np.zeros(n, dtype=bool)
+        for t in range(horizon):
+            act = rng.uniform(-1, 1, (n,) + refs[0].action_space.shape)
+            obs_v, rew_v, done_v = venv.step(act, active=~done_r)
+            for i, e in enumerate(refs):
+                if done_r[i]:
+                    continue
+                o, r, term, trunc, _ = e.step(act[i])
+                assert bool(term or trunc) == bool(done_v[i]), (env_id, t, i)
+                assert abs(r - rew_v[i]) < atol, (env_id, t, i, r, rew_v[i])
+                if term or trunc:
+                    done_r[i] = True
+                else:
+                    np.testing.assert_allclose(o, obs_v[i], atol=atol, err_msg=f"{env_id} t={t} lane={i}")
+            if done_r.all():
+                break
+    finally:
+        venv.close()
+        for e in refs:
+            e.close()
+
+
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_mjvecenv_reward_terms_decompose_the_reward():
+    import gymnasium as gym
+
+    from evotorch_tpu.envs.mujoco.mjvecenv import MjVecEnv
+
+    venv = MjVecEnv(lambda: gym.make("Hopper-v5"), 4)
+    try:
+        venv.seed(range(4))
+        venv.reset()
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            _, rewards, dones = venv.step(rng.uniform(-1, 1, (4, 3)))
+            terms = venv.last_terms
+            assert {"x_velocity", "reward_forward", "reward_ctrl", "reward_survive"} <= set(terms)
+            total = terms["reward_forward"] + terms["reward_ctrl"] + terms["reward_survive"]
+            np.testing.assert_allclose(total, rewards, atol=1e-5)
+    finally:
+        venv.close()
+
+
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_mjvecenv_inactive_lanes_and_autoreset():
+    import gymnasium as gym
+
+    from evotorch_tpu.envs.mujoco.mjvecenv import MjVecEnv
+
+    venv = MjVecEnv(lambda: gym.make("InvertedPendulum-v5"), 3)
+    try:
+        venv.seed(range(3))
+        venv.reset()
+        active = np.array([True, False, True])
+        obs, rewards, dones = venv.step(np.ones((3, 1)), active=active)
+        assert np.isnan(obs[1]).all() and rewards[1] == 0.0 and not dones[1]
+        assert np.isfinite(obs[0]).all() and np.isfinite(obs[2]).all()
+        # drive lane 0 to termination; its returned obs must be a fresh reset
+        for _ in range(200):
+            obs, _, dones = venv.step(np.ones((3, 1)), active=active)
+            if dones[0]:
+                break
+        assert dones[0]
+        assert np.isfinite(obs[0]).all()  # eager auto-reset observation
+        assert venv._steps[0] == 0
+    finally:
+        venv.close()
+
+
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_gymne_vectorized_lane_block_uses_mjvecenv():
+    from evotorch_tpu.envs.mujoco.mjvecenv import MjVecEnv
+    from evotorch_tpu.neuroevolution import GymNE
+
+    p = GymNE(
+        "InvertedPendulum-v5",
+        "Linear(obs_length, act_length)",
+        observation_normalization=True,
+        num_envs=6,
+        episode_length=60,
+    )
+    batch = p.generate_batch(8)  # 6-lane blocks: exercises the short chunk too
+    p.evaluate(batch)
+    assert isinstance(p._make_vector_env(), MjVecEnv)
+    evals = np.asarray(batch.evals[:, 0])
+    assert np.isfinite(evals).all() and (evals >= 1).all()
+    assert p.status["total_interaction_count"] > 0
+    assert p.get_observation_stats().count > 0
+    # generic envs must keep falling back to the lockstep SyncVectorEnv
+    from evotorch_tpu.neuroevolution.net.hostvecenv import SyncVectorEnv
+
+    q = GymNE("CartPole-v1", "Linear(obs_length, act_length)", num_envs=2, episode_length=20)
+    q.generate_batch(2)
+    assert isinstance(q._make_vector_env(), SyncVectorEnv)
+
+
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_gymne_hopper_host_pool_two_generations_with_obs_norm_sync():
+    """The issue's acceptance workload: GymNE("Hopper-v5") for >= 2
+    generations through the ``num_actors`` host pool, with observation
+    normalization delta-synced against the real env each round."""
+    from evotorch_tpu.algorithms import PGPE
+    from evotorch_tpu.neuroevolution import GymNE
+
+    p = GymNE(
+        "Hopper-v5",
+        "Linear(obs_length, act_length)",
+        observation_normalization=True,
+        episode_length=80,
+        num_actors=2,
+    )
+    try:
+        searcher = PGPE(
+            p,
+            popsize=6,
+            center_learning_rate=0.1,
+            stdev_learning_rate=0.1,
+            radius_init=0.3,
+            optimizer="clipup",
+            ranking_method="centered",
+        )
+        searcher.step()
+        count_gen1 = p.get_observation_stats().count
+        interactions_gen1 = p.status["total_interaction_count"]
+        assert count_gen1 > 0  # worker deltas merged home
+        assert interactions_gen1 > 0
+        assert p.status["total_episode_count"] >= 6
+        pool = p._host_pool
+        assert pool is not None and pool.is_alive()
+        import os
+
+        assert all(pid != os.getpid() for pid in pool.worker_pids)
+
+        searcher.step()  # second generation: deltas stay cumulative
+        assert p.get_observation_stats().count > count_gen1
+        assert p.status["total_interaction_count"] > interactions_gen1
+        assert np.isfinite(float(searcher.status["mean_eval"]))
+    finally:
+        p.kill_actors()
+
+
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_fidelity_harness_smoke_invertedpendulum():
+    from evotorch_tpu.envs.mujoco.fidelity import (
+        format_fidelity_markdown,
+        run_fidelity,
+    )
+
+    report = run_fidelity(["cartpole"], n_seqs=3, n_steps=40, seed=0)
+    pair = report["pairs"]["cartpole"]
+    assert pair["mujoco_env"] == "InvertedPendulum-v5"
+    total = pair["terms"]["reward_total"]
+    assert np.isfinite(total["native_mean"]) and np.isfinite(total["mujoco_mean"])
+    assert pair["episode"]["mujoco_mean_length"] > 0
+    md = format_fidelity_markdown(report)
+    assert "InvertedPendulum-v5" in md and "reward_total" in md
+    import json
+
+    json.dumps(report)  # the report must be JSON-serializable as checked in
+
+
+def test_native_reward_terms_sum_to_batch_step_reward():
+    """Fast tier, pure JAX: the per-term decomposition added for the
+    fidelity harness must exactly re-compose each env's step reward."""
+    import jax
+    import jax.numpy as jnp
+
+    from evotorch_tpu.envs import make_env
+
+    for name in ("halfcheetah", "walker2d"):
+        env = make_env(name)
+        keys = jax.random.split(jax.random.key(0), 3)
+        state, _ = env.batch_reset(keys)
+        actions = jax.random.uniform(jax.random.key(1), (3, env.sys.num_act), minval=-1, maxval=1)
+        state, _, reward, _ = env.batch_step(state, actions)
+        terms = env.batch_reward_terms(state.obs_state, jnp.clip(actions, -1, 1).T)
+        total = terms["reward_forward"] + terms["reward_ctrl"] + terms["reward_survive"]
+        np.testing.assert_allclose(np.asarray(total), np.asarray(reward), atol=1e-5, err_msg=name)
