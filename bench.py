@@ -96,7 +96,9 @@ def main():
         state = fresh_pgpe_state(policy.parameter_count)
         if mode == "episodes_compact":
             ask_jit = jax.jit(partial(ask, popsize=popsize))
-            tell_jit = jax.jit(tell)
+            # donate the state like the monolithic modes' jitted generation
+            # below: tell is state-in/state-out, so the update runs in place
+            tell_jit = jax.jit(tell, donate_argnums=(0,))
             ckw = compact_kwargs(cfg)
 
             def gen(state, key, prewarm=False):

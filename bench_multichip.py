@@ -22,6 +22,7 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 from bench_common import (
     bench_config,
@@ -133,7 +134,7 @@ def main():
                 pgpe_ask(k, s, popsize=popsize), pop_sharding
             )
         )
-        tell_jit = jax.jit(pgpe_tell)
+        tell_jit = jax.jit(pgpe_tell, donate_argnums=(0,))
 
         first_gen = [True]
         ckw = compact_kwargs(cfg, n_shards=mesh_size)
@@ -159,7 +160,7 @@ def main():
 
     else:
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0,))
         def generation(state, key, stats):
             k1, k2 = jax.random.split(key)
             values = pgpe_ask(k1, state, popsize=popsize)

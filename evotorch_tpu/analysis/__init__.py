@@ -1,0 +1,47 @@
+"""Static + runtime correctness guardrails for the compiled hot paths.
+
+- :mod:`~evotorch_tpu.analysis.graftlint` / ``checkers`` — the ``graftlint``
+  AST lint suite (PRNG discipline, retrace hazards, host-sync hazards,
+  donation opportunities, sharding/axis-name hygiene, dtype leaks). Run it
+  with ``python -m evotorch_tpu.analysis`` (or ``scripts/lint.sh``); findings
+  not in ``analysis/baseline.json`` fail the fast tier via
+  ``tests/test_lint.py``.
+- :mod:`~evotorch_tpu.analysis.retrace_sentinel` — a runtime compile counter
+  (over ``jax.log_compiles``) asserting steady-state compile counts around
+  the eval contracts and ask-tell loops.
+
+See ``docs/static_analysis.md`` for the checker catalog and the baseline
+workflow.
+"""
+
+from .graftlint import (  # noqa: F401
+    Finding,
+    apply_baseline,
+    default_baseline_path,
+    default_targets,
+    lint_sources,
+    load_baseline,
+    run_lint,
+    save_baseline,
+)
+from .retrace_sentinel import (  # noqa: F401
+    CompileLog,
+    RetraceError,
+    assert_compiles,
+    track_compiles,
+)
+
+__all__ = [
+    "Finding",
+    "run_lint",
+    "lint_sources",
+    "load_baseline",
+    "save_baseline",
+    "apply_baseline",
+    "default_targets",
+    "default_baseline_path",
+    "CompileLog",
+    "RetraceError",
+    "track_compiles",
+    "assert_compiles",
+]

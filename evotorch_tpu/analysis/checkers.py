@@ -1,0 +1,897 @@
+"""The graftlint checkers — six JAX-specific static analyses.
+
+=============  ==============================================================
+checker        what it catches
+=============  ==============================================================
+``prng``       a PRNG key consumed by two sampling calls without an
+               intervening ``split`` (including across loop iterations —
+               keys threaded out of loops un-split)
+``retrace``    ``jax.jit`` wrappers rebuilt per call: jit built inside a
+               loop, jit over a fresh lambda/bound method inside a function
+               (every call re-traces), f-strings passed to jitted callables
+``host-sync``  ``.item()`` / ``float()`` / ``int()`` / ``np.asarray()`` on
+               traced values inside jit/lax-traced functions, and
+               per-iteration device syncs (``float(jax_helper(...))``) in
+               host loops
+``donation``   jitted state-in/state-out steps (first arg a state/carry
+               pytree) lacking ``donate_argnums`` — the ask-tell hot loop
+               then allocates a fresh state buffer every generation
+``axis-name``  ``pmean``/``psum``/``axis_index``/``PartitionSpec`` string
+               axis literals that match no declared mesh axis (typos silently
+               crash late or, worse, silently de-shard)
+``dtype``      float64/int64 leaks into the f32/bf16 compute path: x64 dtype
+               references, ``dtype="float64"`` strings, np 64-bit constants
+               materialized inside traced code
+=============  ==============================================================
+
+All checkers are pure-AST (no imports executed). Each returns
+:class:`~evotorch_tpu.analysis.graftlint.Finding`\\ s whose ``detail`` field
+is a stable signature component (see graftlint's baseline notes).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .graftlint import Finding, ModuleInfo, ProjectInfo, dotted_name
+
+__all__ = ["CHECKERS"]
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+#: jax.random functions that CONSUME a key (first positional argument)
+_SAMPLERS = {
+    "ball", "bernoulli", "beta", "binomial", "bits", "categorical", "cauchy",
+    "chisquare", "choice", "dirichlet", "double_sided_maxwell", "exponential",
+    "f", "gamma", "generalized_normal", "geometric", "gumbel", "laplace",
+    "loggamma", "logistic", "maxwell", "multivariate_normal", "normal",
+    "orthogonal", "pareto", "permutation", "poisson", "rademacher", "randint",
+    "rayleigh", "t", "triangular", "truncated_normal", "uniform", "wald",
+    "weibull_min",
+}
+
+#: jax.random functions that DERIVE fresh keys (do not invalidate the parent
+#: for further derivation; assigning their result rebinds targets as fresh)
+_DERIVERS = {"split", "fold_in", "key", "PRNGKey", "clone", "wrap_key_data"}
+
+_TRACED_COMBINATORS = {
+    "jax.jit",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.scan",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.grad",
+    "jax.value_and_grad",
+}
+
+_COLLECTIVES = {
+    "jax.lax.pmean",
+    "jax.lax.psum",
+    "jax.lax.pmax",
+    "jax.lax.pmin",
+    "jax.lax.axis_index",
+    "jax.lax.all_gather",
+    "jax.lax.ppermute",
+    "jax.lax.psum_scatter",
+    "jax.lax.all_to_all",
+    "jax.lax.pshuffle",
+}
+
+_STATE_PARAM_RE = re.compile(r"^(new_)?(state|carry|opt_state|optimizer_state)$|^\w+_(state|carry)$")
+
+
+def _is_jit_call(mod: ModuleInfo, node: ast.Call) -> bool:
+    """``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    canon = mod.canon(node.func)
+    if canon == "jax.jit":
+        return True
+    if canon == "functools.partial" and node.args:
+        return mod.canon(node.args[0]) == "jax.jit"
+    return False
+
+
+def _jit_kwargs(mod: ModuleInfo, node: ast.Call) -> Dict[str, ast.AST]:
+    return {kw.arg: kw.value for kw in node.keywords if kw.arg}
+
+
+def _jit_decoration(mod: ModuleInfo, fn: ast.AST) -> Optional[ast.Call]:
+    """The jit decorator Call of a FunctionDef, if any (``@jax.jit`` bare
+    decorators are returned as a synthetic empty-kwargs marker)."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call) and _is_jit_call(mod, dec):
+            return dec
+        if mod.canon(dec) == "jax.jit":
+            return ast.Call(func=dec, args=[], keywords=[])
+    return None
+
+
+def _static_param_names(mod: ModuleInfo, fn: ast.AST, jit_call: Optional[ast.Call]) -> Set[str]:
+    """Parameter names pinned static by the jit decoration/wrapping —
+    ``int()``/``float()`` on those is host math on static config, not a sync."""
+    if jit_call is None or not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set()
+    names: Set[str] = set()
+    params = [a.arg for a in list(fn.args.posonlyargs) + list(fn.args.args)]
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            node = kw.value
+            elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+            for elt in elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    names.add(elt.value)
+        elif kw.arg == "static_argnums":
+            node = kw.value
+            elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+            for elt in elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                    if 0 <= elt.value < len(params):
+                        names.add(params[elt.value])
+    return names
+
+
+def _resolve_local_def(mod: ModuleInfo, scope: ast.AST, name: str) -> Optional[ast.AST]:
+    """A FunctionDef named ``name`` visible from ``scope`` (nearest enclosing
+    scope first, then module level)."""
+    cur: Optional[ast.AST] = scope
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            body = cur.body
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and stmt.name == name:
+                    return stmt
+        cur = getattr(cur, "_gl_parent", None)
+    return None
+
+
+def _collect_traced(mod: ModuleInfo) -> Dict[ast.AST, Set[str]]:
+    """Function/lambda nodes whose bodies run under trace, mapped to their
+    static parameter names. Sources of truth:
+
+    - defs decorated with ``jax.jit`` / ``partial(jax.jit, ...)``;
+    - lambdas / local defs passed (by name or inline) to jit/vmap/shard_map
+      or the ``lax`` control-flow combinators;
+    - defs nested inside an already-traced def.
+
+    Memoized per module (host-sync and dtype both need it).
+    """
+    cached = getattr(mod, "_gl_traced_cache", None)
+    if cached is not None:
+        return cached
+    traced: Dict[ast.AST, Set[str]] = {}
+    mod._gl_traced_cache = traced  # type: ignore[attr-defined]
+
+    def mark(fn: ast.AST, statics: Set[str]):
+        if fn in traced:
+            traced[fn] |= statics
+        else:
+            traced[fn] = set(statics)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            dec = _jit_decoration(mod, node)
+            if dec is not None:
+                mark(node, _static_param_names(mod, node, dec))
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canon(node.func) or ""
+        is_jit = _is_jit_call(mod, node)
+        if canon not in _TRACED_COMBINATORS and not is_jit:
+            continue
+        statics: Set[str] = set()
+        candidates = list(node.args)
+        if canon == "functools.partial":
+            candidates = candidates[1:]  # skip the jax.jit argument itself
+        for arg in candidates:
+            target: Optional[ast.AST] = None
+            if isinstance(arg, ast.Lambda):
+                target = arg
+            elif isinstance(arg, ast.Name):
+                target = _resolve_local_def(mod, mod.enclosing_function(node) or mod.tree, arg.id)
+            elif isinstance(arg, ast.Call) and mod.canon(arg.func) == "functools.partial" and arg.args:
+                inner = arg.args[0]
+                if isinstance(inner, ast.Name):
+                    target = _resolve_local_def(
+                        mod, mod.enclosing_function(node) or mod.tree, inner.id
+                    )
+            if target is not None:
+                if is_jit and isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    statics = _static_param_names(mod, target, node)
+                mark(target, statics)
+    # nested defs inside traced defs trace too
+    frontier = list(traced)
+    while frontier:
+        fn = frontier.pop()
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                if sub not in traced:
+                    mark(sub, set())
+                    frontier.append(sub)
+    return traced
+
+
+def _in_traced(mod: ModuleInfo, traced: Dict[ast.AST, Set[str]], node: ast.AST):
+    """(traced_fn, statics) for the innermost traced function containing
+    ``node``, else (None, empty). Statics accumulate from enclosing traced
+    scopes (a closure over a static name is still static)."""
+    statics: Set[str] = set()
+    hit: Optional[ast.AST] = None
+    cur = getattr(node, "_gl_parent", None)
+    while cur is not None:
+        if cur in traced:
+            if hit is None:
+                hit = cur
+            statics |= traced[cur]
+        cur = getattr(cur, "_gl_parent", None)
+    return hit, statics
+
+
+# ---------------------------------------------------------------------------
+# (a) PRNG discipline
+# ---------------------------------------------------------------------------
+
+
+class _PrngScope:
+    """Linear abstract interpretation of one function body: key names go
+    fresh -> consumed; a second consumption without an intervening
+    split/fold_in is a finding. Branches are analyzed separately (a branch
+    ending in return/raise does not leak its consumption), loops are walked
+    twice to expose cross-iteration reuse."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.findings: List[Finding] = []
+        self._reported: Set[int] = set()
+
+    # -- expression side -----------------------------------------------------
+    def _consumptions(self, expr: ast.AST):
+        """(node, key_name) for each jax.random sampler call consuming a bare
+        Name key inside ``expr`` (nested lambdas/defs handled separately)."""
+        out = []
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # separate scope (walk still descends; filter below)
+            if not isinstance(node, ast.Call):
+                continue
+            canon = self.mod.canon(node.func) or ""
+            if not canon.startswith("jax.random."):
+                continue
+            fname = canon.rsplit(".", 1)[-1]
+            if fname in _SAMPLERS and node.args and isinstance(node.args[0], ast.Name):
+                # skip if this call sits inside a nested function scope
+                inner = self.mod.enclosing_function(node)
+                outer = self.mod.enclosing_function(expr) if not isinstance(
+                    expr, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ) else expr
+                if inner is not None and inner is not outer and not isinstance(expr, ast.Module):
+                    continue
+                out.append((node, node.args[0].id))
+        return out
+
+    def _derivation_call(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            canon = self.mod.canon(expr.func) or ""
+            if canon.startswith("jax.random.") and canon.rsplit(".", 1)[-1] in _DERIVERS:
+                return canon
+        return None
+
+    # -- statement side ------------------------------------------------------
+    def consume(self, state: Dict[str, str], node: ast.AST, name: str, in_second_loop_pass: bool):
+        status = state.get(name)
+        if status == "consumed":
+            if id(node) in self._reported:
+                return
+            self._reported.add(id(node))
+            if in_second_loop_pass:
+                msg = (
+                    f"PRNG key `{name}` is consumed again on the next loop iteration "
+                    "without a jax.random.split — every iteration draws the same stream"
+                )
+                detail = f"loop-reuse:{name}"
+            else:
+                msg = (
+                    f"PRNG key `{name}` is consumed by a second sampling call without an "
+                    "intervening jax.random.split — the draws are identical/correlated"
+                )
+                detail = f"reuse:{name}"
+            self.findings.append(self.mod.finding("prng", node, msg, detail))
+        else:
+            state[name] = "consumed"
+
+    def eval_expr(self, state: Dict[str, str], expr: ast.AST, second_pass: bool):
+        for node, name in self._consumptions(expr):
+            self.consume(state, node, name, second_pass)
+
+    def assign_targets(self, state: Dict[str, str], targets, value: ast.AST):
+        derivation = self._derivation_call(value)
+        names: List[str] = []
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                names.append(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                names.extend(e.id for e in tgt.elts if isinstance(e, ast.Name))
+        if derivation is not None:
+            for n in names:
+                state[n] = "fresh"
+        elif isinstance(value, ast.Name) and value.id in state and len(names) == 1:
+            state[names[0]] = state[value.id]
+        else:
+            for n in names:
+                state.pop(n, None)
+
+    def walk_block(self, stmts, state: Dict[str, str], second_pass: bool = False) -> bool:
+        """Returns True if the block terminates (return/raise/continue/break)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                if isinstance(stmt, ast.Return) and stmt.value is not None:
+                    self.eval_expr(state, stmt.value, second_pass)
+                return True
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                return True
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.run_function(stmt)
+                continue
+            if isinstance(stmt, ast.Assign):
+                self.eval_expr(state, stmt.value, second_pass)
+                self.assign_targets(state, stmt.targets, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self.eval_expr(state, stmt.value, second_pass)
+                self.assign_targets(state, [stmt.target], stmt.value)
+            elif isinstance(stmt, ast.AugAssign):
+                self.eval_expr(state, stmt.value, second_pass)
+            elif isinstance(stmt, ast.Expr):
+                self.eval_expr(state, stmt.value, second_pass)
+            elif isinstance(stmt, ast.If):
+                self.eval_expr(state, stmt.test, second_pass)
+                s_body = dict(state)
+                s_else = dict(state)
+                t_body = self.walk_block(stmt.body, s_body, second_pass)
+                t_else = self.walk_block(stmt.orelse, s_else, second_pass)
+                if t_body and t_else:
+                    pass  # both paths leave; keep pre-state
+                elif t_body:
+                    state.clear()
+                    state.update(s_else)
+                elif t_else:
+                    state.clear()
+                    state.update(s_body)
+                else:
+                    merged = dict(s_else)
+                    for k, v in s_body.items():
+                        if v == "consumed" or merged.get(k) == "consumed":
+                            merged[k] = "consumed"
+                        else:
+                            merged[k] = v
+                    state.clear()
+                    state.update(merged)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self.eval_expr(state, stmt.iter, second_pass)
+                self.assign_targets(state, [stmt.target], stmt.iter)
+                terminated = self.walk_block(stmt.body, state, second_pass)
+                if not terminated:
+                    # second walk: anything still consumed from iteration one
+                    # that gets consumed again is cross-iteration reuse. The
+                    # loop target is re-assigned by the iteration protocol, so
+                    # re-freshen it first (`for k in jax.random.split(key, n)`
+                    # hands a NEW key to every iteration)
+                    self.assign_targets(state, [stmt.target], stmt.iter)
+                    self.walk_block(stmt.body, state, second_pass=True)
+                self.walk_block(stmt.orelse, state, second_pass)
+            elif isinstance(stmt, ast.While):
+                self.eval_expr(state, stmt.test, second_pass)
+                terminated = self.walk_block(stmt.body, state, second_pass)
+                if not terminated:
+                    self.walk_block(stmt.body, state, second_pass=True)
+                self.walk_block(stmt.orelse, state, second_pass)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self.eval_expr(state, item.context_expr, second_pass)
+                self.walk_block(stmt.body, state, second_pass)
+            elif isinstance(stmt, ast.Try):
+                self.walk_block(stmt.body, state, second_pass)
+                for handler in stmt.handlers:
+                    self.walk_block(handler.body, dict(state), second_pass)
+                self.walk_block(stmt.orelse, state, second_pass)
+                self.walk_block(stmt.finalbody, state, second_pass)
+        return False
+
+    def run_function(self, fn: ast.AST):
+        state: Dict[str, str] = {}
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+                if re.search(r"(^|_)(key|keys|rng)s?($|_)", a.arg) or a.arg.endswith("_key"):
+                    state[a.arg] = "fresh"
+        body = fn.body if isinstance(fn.body, list) else [ast.Return(value=fn.body)]
+        self.walk_block(body, state)
+
+
+def check_prng(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
+    scope = _PrngScope(mod)
+    # module level (scripts) + every function, each as its own scope
+    scope.walk_block(
+        [s for s in mod.tree.body if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))],
+        {},
+    )
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = _PrngScope(mod)
+            inner._reported = scope._reported  # share dedupe across scopes
+            inner.run_function(node)
+            scope.findings.extend(inner.findings)
+        elif isinstance(node, ast.Lambda):
+            inner = _PrngScope(mod)
+            inner._reported = scope._reported
+            inner.run_function(node)
+            scope.findings.extend(inner.findings)
+    return scope.findings
+
+
+# ---------------------------------------------------------------------------
+# (b) retrace hazards
+# ---------------------------------------------------------------------------
+
+
+_MEMO_DECORATORS = {"functools.lru_cache", "functools.cache"}
+
+
+def _result_is_cached(mod: ModuleInfo, jit_call: ast.Call, fn: ast.AST) -> bool:
+    """True for the sanctioned builder pattern: the jit result is stored into
+    a subscript (``cache[key] = fn``, directly or via a name) somewhere in
+    the enclosing function, or the enclosing function is decorated with
+    ``functools.lru_cache``/``functools.cache`` (matched canonically — a
+    decorator merely *named* like a cache does not memoize)."""
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if (mod.canon(target) or "") in _MEMO_DECORATORS:
+                return True
+    parent = getattr(jit_call, "_gl_parent", None)
+    assigned: Optional[str] = None
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        tgt = parent.targets[0]
+        if isinstance(tgt, ast.Subscript):
+            return True  # `cache[key] = jax.jit(...)` directly
+        if isinstance(tgt, ast.Name):
+            assigned = tgt.id
+    if assigned is None or fn is None:
+        return False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == assigned
+                ):
+                    return True
+    return False
+
+
+def check_retrace(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    jitted_names: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit_call(mod, node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        jitted_names.add(tgt.id)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not _is_jit_call(mod, node):
+            continue
+        # decorators are definition-time, not call-time: skip
+        parent = getattr(node, "_gl_parent", None)
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) and node in parent.decorator_list:
+            continue
+        fn = mod.enclosing_function(node)
+        loops = mod.enclosing_loops(node)
+        wrapped = node.args[0] if node.args else None
+        if mod.canon(node.func) == "functools.partial" and len(node.args) >= 2:
+            wrapped = node.args[1]
+        wrapped_desc = None
+        if isinstance(wrapped, ast.Lambda):
+            wrapped_desc = "a fresh lambda"
+        elif isinstance(wrapped, ast.Attribute):
+            wrapped_desc = f"the bound method `{dotted_name(wrapped)}`"
+        elif (
+            isinstance(wrapped, ast.Call)
+            and (mod.canon(wrapped.func) or "") in ("jax.vmap", "jax.pmap")
+            and wrapped.args
+            and isinstance(wrapped.args[0], (ast.Attribute, ast.Lambda))
+        ):
+            inner_name = dotted_name(wrapped.args[0]) or "<lambda>"
+            wrapped_desc = f"a fresh vmap wrapper over `{inner_name}`"
+        if loops:
+            if _result_is_cached(mod, node, fn):
+                continue  # cache-filling warm-up loop: one jit per cache key
+            findings.append(
+                mod.finding(
+                    "retrace",
+                    node,
+                    "jax.jit called inside a loop: the wrapper (and its trace cache) is "
+                    "rebuilt every iteration — hoist the jit out of the loop",
+                    "jit-in-loop",
+                )
+            )
+        elif wrapped_desc is not None and fn is not None and not _result_is_cached(mod, node, fn):
+            findings.append(
+                mod.finding(
+                    "retrace",
+                    node,
+                    f"jax.jit over {wrapped_desc} inside a function: every call of the "
+                    "enclosing function rebuilds the wrapper and re-traces — hoist it to "
+                    "module scope, jit a named function, or cache the wrapper",
+                    f"jit-fresh-callee:{wrapped_desc.split('`')[-2] if '`' in wrapped_desc else 'lambda'}",
+                )
+            )
+
+    # f-string / str(...) arguments handed to a known-jitted callable: the
+    # value becomes (or collides with) a static arg and re-traces per call
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+            continue
+        if node.func.id not in jitted_names:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.JoinedStr) or (
+                isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Name)
+                and arg.func.id in ("str", "repr")
+            ):
+                findings.append(
+                    mod.finding(
+                        "retrace",
+                        arg,
+                        f"f-string/str() argument to jitted `{node.func.id}`: a fresh "
+                        "string per call re-traces on every invocation",
+                        f"str-arg:{node.func.id}",
+                    )
+                )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# (c) host-sync hazards
+# ---------------------------------------------------------------------------
+
+
+def check_host_sync(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    traced = _collect_traced(mod)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn, statics = _in_traced(mod, traced, node)
+
+        # .item() — a device->host scalar sync wherever it runs under trace
+        if (
+            fn is not None
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            findings.append(
+                mod.finding(
+                    "host-sync",
+                    node,
+                    ".item() inside traced code forces a device->host sync (and fails "
+                    "under jit) — keep the value on device",
+                    "item",
+                )
+            )
+            continue
+
+        canon = mod.canon(node.func) or ""
+
+        # np.asarray / np.array under trace: silently materializes the traced
+        # value on host (ConcretizationError under jit, a sync under eager)
+        if fn is not None and canon in ("numpy.asarray", "numpy.array"):
+            findings.append(
+                mod.finding(
+                    "host-sync",
+                    node,
+                    f"{dotted_name(node.func)}() inside traced code pulls the value to "
+                    "host — use jnp, or move the conversion outside the traced function",
+                    "np-asarray",
+                )
+            )
+            continue
+
+        # float()/int()/bool() on non-static values under trace
+        if (
+            fn is not None
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+        ):
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant):
+                continue
+            if isinstance(arg, ast.Name) and arg.id in statics:
+                continue
+            # int(len(...)) / int(x.shape[i]) / int(x.ndim) are static shape
+            # math, not value syncs
+            if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name) and arg.func.id == "len":
+                continue
+            if isinstance(arg, ast.Subscript) and isinstance(arg.value, ast.Attribute) and arg.value.attr == "shape":
+                continue
+            if isinstance(arg, ast.Attribute) and arg.attr in ("ndim", "size"):
+                continue
+            findings.append(
+                mod.finding(
+                    "host-sync",
+                    node,
+                    f"{node.func.id}() on a traced value inside traced code — a "
+                    "concretization/host-sync hazard; mark the argument static or keep "
+                    "the math in jnp",
+                    f"{node.func.id}-in-trace",
+                )
+            )
+            continue
+
+        # host-loop mode: float(helper(...)) / int(helper(...)) where helper
+        # is a project function implemented in jax — a device round-trip per
+        # loop iteration
+        if (
+            fn is None
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int")
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Call)
+            and mod.enclosing_loops(node)
+        ):
+            callee = node.args[0].func
+            callee_name = callee.id if isinstance(callee, ast.Name) else None
+            if callee_name and project.func_uses_jax.get(callee_name):
+                findings.append(
+                    mod.finding(
+                        "host-sync",
+                        node,
+                        f"{node.func.id}({callee_name}(...)) inside a host loop: "
+                        "dispatches a device computation and syncs its result every "
+                        "iteration — compute it on host or batch it",
+                        f"loop-sync:{callee_name}",
+                    )
+                )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# (d) donation opportunities
+# ---------------------------------------------------------------------------
+
+
+def _first_param_of(mod: ModuleInfo, project: ProjectInfo, scope: ast.AST, target: ast.AST) -> Optional[str]:
+    if isinstance(target, ast.Lambda):
+        args = target.args
+        params = list(args.posonlyargs) + list(args.args)
+        return params[0].arg if params else None
+    if isinstance(target, ast.Name):
+        name = mod.name_aliases.get(target.id, target.id)
+        local = _resolve_local_def(mod, scope, name)
+        if local is not None:
+            params = list(local.args.posonlyargs) + list(local.args.args)
+            return params[0].arg if params else None
+        # imported / aliased project function
+        canon = mod.aliases.get(name, name)
+        short = canon.rsplit(".", 1)[-1]
+        return project.func_first_param.get(short)
+    return None
+
+
+def check_donation(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def has_donation(kwargs: Dict[str, ast.AST]) -> bool:
+        return "donate_argnums" in kwargs or "donate_argnames" in kwargs
+
+    def statics_cover_first(kwargs: Dict[str, ast.AST]) -> bool:
+        node = kwargs.get("static_argnums")
+        if node is None:
+            return False
+        elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+        return any(isinstance(e, ast.Constant) and e.value == 0 for e in elts)
+
+    # decorator form
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            dec = _jit_decoration(mod, node)
+            if dec is None:
+                continue
+            kwargs = _jit_kwargs(mod, dec)
+            params = list(node.args.posonlyargs) + list(node.args.args)
+            first = params[0].arg if params else None
+            if (
+                first
+                and _STATE_PARAM_RE.match(first)
+                and not has_donation(kwargs)
+                and not statics_cover_first(kwargs)
+            ):
+                findings.append(
+                    mod.finding(
+                        "donation",
+                        node,
+                        f"jitted `{node.name}` takes the state pytree `{first}` first but "
+                        "does not donate it (donate_argnums=(0,)): the hot loop allocates "
+                        "a fresh state buffer every call instead of updating in place",
+                        f"undonated-state:{node.name}",
+                    )
+                )
+        if not isinstance(node, ast.Call) or not _is_jit_call(mod, node):
+            continue
+        parent = getattr(node, "_gl_parent", None)
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) and node in parent.decorator_list:
+            continue
+        kwargs = _jit_kwargs(mod, node)
+        if has_donation(kwargs) or statics_cover_first(kwargs):
+            continue
+        wrapped = node.args[0] if node.args else None
+        if mod.canon(node.func) == "functools.partial" and len(node.args) >= 2:
+            wrapped = node.args[1]
+        if wrapped is None:
+            continue
+        scope = mod.enclosing_function(node) or mod.tree
+        first = _first_param_of(mod, project, scope, wrapped)
+        if first and _STATE_PARAM_RE.match(first):
+            wrapped_name = dotted_name(wrapped) or "<lambda>"
+            findings.append(
+                mod.finding(
+                    "donation",
+                    node,
+                    f"jax.jit({wrapped_name}) wraps a step whose first arg `{first}` is a "
+                    "state pytree but does not donate it (donate_argnums=(0,)): each call "
+                    "allocates a fresh state instead of reusing the buffers",
+                    f"undonated-state:{wrapped_name}",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# (e) sharding / axis-name hygiene
+# ---------------------------------------------------------------------------
+
+
+def check_axis_names(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    declared = project.axis_names
+    if not declared:
+        return findings
+
+    def check_literal(node: ast.AST, context: str):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value not in declared:
+                findings.append(
+                    mod.finding(
+                        "axis-name",
+                        node,
+                        f"axis name {node.value!r} in {context} matches no declared mesh "
+                        f"axis (declared: {sorted(declared)}) — typo'd collectives fail "
+                        "late or silently de-shard",
+                        f"unknown-axis:{node.value}",
+                    )
+                )
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.canon(node.func) or ""
+        if canon in _COLLECTIVES:
+            if len(node.args) >= 2:
+                check_literal(node.args[1], canon.rsplit(".", 1)[-1])
+            elif len(node.args) == 1 and canon.endswith("axis_index"):
+                check_literal(node.args[0], "axis_index")
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    check_literal(kw.value, canon.rsplit(".", 1)[-1])
+        elif canon.endswith("PartitionSpec") or canon == "jax.sharding.PartitionSpec":
+            for arg in node.args:
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    for elt in arg.elts:
+                        check_literal(elt, "PartitionSpec")
+                else:
+                    check_literal(arg, "PartitionSpec")
+        else:
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    check_literal(kw.value, f"{canon or 'call'}(axis_name=...)")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# (f) dtype leaks
+# ---------------------------------------------------------------------------
+
+
+def check_dtype(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    traced = _collect_traced(mod)
+
+    for node in ast.walk(mod.tree):
+        canon = mod.canon(node) if isinstance(node, (ast.Attribute,)) else None
+        if canon in ("jax.numpy.float64", "jax.numpy.int64"):
+            findings.append(
+                mod.finding(
+                    "dtype",
+                    node,
+                    f"{dotted_name(node)} reference: x64 dtypes re-promote the f32/bf16 "
+                    "compute path (and require jax_enable_x64) — use 32-bit dtypes",
+                    f"x64:{canon.rsplit('.', 1)[-1]}",
+                )
+            )
+        if isinstance(node, ast.Attribute):
+            canon_np = mod.canon(node)
+            if canon_np in ("numpy.float64", "numpy.int64"):
+                fn, _ = _in_traced(mod, traced, node)
+                if fn is not None:
+                    findings.append(
+                        mod.finding(
+                            "dtype",
+                            node,
+                            f"{dotted_name(node)} inside traced code: a strong-typed "
+                            "64-bit numpy constant re-promotes bf16/f32 carries — use a "
+                            "python scalar or an explicit 32-bit dtype",
+                            f"np-x64:{canon_np.rsplit('.', 1)[-1]}",
+                        )
+                    )
+        if isinstance(node, ast.Call):
+            canon_call = mod.canon(node.func) or ""
+            if canon_call.startswith(("jax.numpy.", "jax.")):
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "dtype"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value in ("float64", "int64")
+                    ):
+                        findings.append(
+                            mod.finding(
+                                "dtype",
+                                kw.value,
+                                f"dtype={kw.value.value!r} on a jnp call: x64 dtypes "
+                                "re-promote the f32/bf16 compute path",
+                                f"dtype-str:{kw.value.value}",
+                            )
+                        )
+            if canon_call == "jax.config.update" and node.args:
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Constant) and arg0.value == "jax_enable_x64":
+                    findings.append(
+                        mod.finding(
+                            "dtype",
+                            node,
+                            "jax_enable_x64 flips every default dtype in the process to "
+                            "64-bit — the bf16/f32 compute-path contract breaks globally",
+                            "enable-x64",
+                        )
+                    )
+    return findings
+
+
+CHECKERS = {
+    "prng": check_prng,
+    "retrace": check_retrace,
+    "host-sync": check_host_sync,
+    "donation": check_donation,
+    "axis-name": check_axis_names,
+    "dtype": check_dtype,
+}

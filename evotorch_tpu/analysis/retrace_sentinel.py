@@ -1,0 +1,131 @@
+"""Runtime retrace sentinel: count XLA compilations over a code region.
+
+The static side (:mod:`evotorch_tpu.analysis.checkers`) catches retrace
+*hazards*; this is the runtime ground truth. It rides on ``jax.log_compiles``:
+jax logs one ``"Compiling <name> with global shapes ..."`` record per actual
+trace+compile (executable-cache misses; persistent-compilation-cache hits
+still log, which is correct — a dispatch-cache miss IS a retrace, the
+persistent cache only makes it cheaper). We attach a counting handler to the
+emitting logger, so the sentinel needs no private jax APIs beyond the logger
+name, and a canary test (``tests/test_retrace_sentinel.py``) guards against
+the log format drifting out from under us on a jax upgrade.
+
+Usage::
+
+    with track_compiles() as log:
+        step(state, key)
+    assert log.count == 0            # steady state: nothing recompiled
+
+    with assert_compiles(0):         # raises RetraceError otherwise
+        for _ in range(3):
+            state, scores = step(state, key)
+
+Tests wrap the four eval contracts (budget / episodes / episodes_compact /
+episodes_refill) and the jitted PGPE/SNES ask-tell steps with this, so any
+change that starts retracing in steady state fails the fast tier.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["CompileLog", "RetraceError", "track_compiles", "assert_compiles"]
+
+# the logger that emits exactly one "Compiling <name> with global shapes"
+# record per trace+lower (jax 0.4.x: jax/_src/interpreters/pxla.py)
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+_COMPILE_RE = re.compile(r"^Compiling (\S+) with global shapes")
+# siblings that log_compiles also turns chatty; silenced under quiet=True
+_NOISY_LOGGERS = ("jax._src.dispatch", "jax._src.compiler")
+
+
+class RetraceError(AssertionError):
+    """Raised by :func:`assert_compiles` when a region compiled more than its
+    budget — a steady-state retrace."""
+
+
+@dataclass
+class CompileLog:
+    """Names of the programs compiled while tracking was active."""
+
+    names: List[str] = field(default_factory=list)
+
+    @property
+    def count(self) -> int:
+        return len(self.names)
+
+    def count_matching(self, substring: str) -> int:
+        return sum(1 for n in self.names if substring in n)
+
+
+class _CountingHandler(logging.Handler):
+    def __init__(self, log: CompileLog):
+        super().__init__(level=logging.DEBUG)
+        self._log = log
+
+    def emit(self, record: logging.LogRecord) -> None:
+        m = _COMPILE_RE.match(record.getMessage())
+        if m:
+            self._log.names.append(m.group(1))
+
+
+@contextlib.contextmanager
+def track_compiles(*, quiet: bool = True):
+    """Context manager yielding a :class:`CompileLog` that records every XLA
+    compilation inside the block. ``quiet=True`` (default) keeps the
+    log_compiles chatter off the console while tracking."""
+    import jax
+
+    log = CompileLog()
+    handler = _CountingHandler(log)
+    logger = logging.getLogger(_PXLA_LOGGER)
+    old_level = logger.level
+    old_propagate = logger.propagate
+    noisy = [logging.getLogger(n) for n in _NOISY_LOGGERS]
+    old_noisy = [lg.propagate for lg in noisy]
+    # a NullHandler as well as propagate=False: a handler-less, non-
+    # propagating logger falls through to logging.lastResort (stderr)
+    null = logging.NullHandler()
+    logger.addHandler(handler)
+    logger.setLevel(logging.DEBUG)
+    if quiet:
+        logger.propagate = False
+        for lg in noisy:
+            lg.propagate = False
+            lg.addHandler(null)
+    try:
+        with jax.log_compiles():
+            yield log
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+        logger.propagate = old_propagate
+        for lg, prop in zip(noisy, old_noisy):
+            lg.propagate = prop
+            if quiet:
+                lg.removeHandler(null)
+
+
+@contextlib.contextmanager
+def assert_compiles(
+    at_most: int = 0, *, match: Optional[str] = None, quiet: bool = True
+):
+    """Assert the block compiles at most ``at_most`` programs (optionally
+    only counting program names containing ``match``); raises
+    :class:`RetraceError` listing the offending programs otherwise.
+
+    ``assert_compiles(0)`` around a warmed-up hot loop is the steady-state
+    contract: the executables are cached, nothing re-traces."""
+    with track_compiles(quiet=quiet) as log:
+        yield log
+    names = log.names if match is None else [n for n in log.names if match in n]
+    if len(names) > at_most:
+        raise RetraceError(
+            f"expected at most {at_most} compilation(s)"
+            + (f" matching {match!r}" if match else "")
+            + f", observed {len(names)}: {names}"
+        )

@@ -734,6 +734,10 @@ def make_functional_sampler(distribution_class: Type[Distribution]) -> Callable:
     param_ndims = distribution_class.PARAMETER_NDIMS
 
     def sampler(key, num_solutions: int, parameters: dict) -> jnp.ndarray:
+        # normalized ONCE on the host side: num_solutions must never look
+        # like a traced value inside the vmapped `one` below (graftlint
+        # `host-sync` — int() under trace is a concretization hazard)
+        num_solutions = int(num_solutions)
         array_params = {
             k: jnp.asarray(v)
             for k, v in parameters.items()
@@ -745,7 +749,7 @@ def make_functional_sampler(distribution_class: Type[Distribution]) -> Callable:
             nd = param_ndims[k]
             batch_shape = jnp.broadcast_shapes(batch_shape, v.shape[: v.ndim - nd])
         if batch_shape == ():
-            return distribution_class._sample(key, {**array_params, **other_params}, int(num_solutions))
+            return distribution_class._sample(key, {**array_params, **other_params}, num_solutions)
 
         import math as _math
 
@@ -758,7 +762,7 @@ def make_functional_sampler(distribution_class: Type[Distribution]) -> Callable:
         keys = jax.random.split(key, bsize)
 
         def one(key, params):
-            return distribution_class._sample(key, {**params, **other_params}, int(num_solutions))
+            return distribution_class._sample(key, {**params, **other_params}, num_solutions)
 
         out = jax.vmap(one)(keys, flat_params)
         return out.reshape(batch_shape + out.shape[1:])

@@ -62,7 +62,14 @@ def _action_sequences(rng: np.random.Generator, n_seqs: int, n_steps: int, act_d
 
 def _native_trajectories(env, actions: np.ndarray, seed: int) -> Dict[str, np.ndarray]:
     """Drive the native env with ``actions`` ``(S, T, na)``; returns per-step
-    ``(S, T)`` term traces (NaN once a lane's episode has ended) + ``alive``."""
+    ``(S, T)`` term traces (NaN once a lane's episode has ended) + ``alive``.
+
+    The per-call ``jax.jit`` wrappers below are baselined graftlint
+    ``retrace`` findings: ``run_fidelity`` constructs a FRESH env per pair
+    and drives it through here exactly once, so one trace per env is
+    inherent — and caching the wrappers on env identity would never hit
+    while pinning dead envs (and their executables) for the process
+    lifetime."""
     import jax
     import jax.numpy as jnp
 
@@ -75,9 +82,10 @@ def _native_trajectories(env, actions: np.ndarray, seed: int) -> Dict[str, np.nd
     else:
         state, _ = jax.vmap(env.reset)(keys)
         step = jax.jit(jax.vmap(env.step))
-    has_terms = hasattr(env, "batch_reward_terms")
-    if has_terms:
-        terms_fn = jax.jit(lambda st, a: env.batch_reward_terms(st, a))
+    terms_fn = None
+    if hasattr(env, "batch_reward_terms"):
+        terms_fn = jax.jit(env.batch_reward_terms)
+    has_terms = terms_fn is not None
 
     out = {"reward_total": np.full((S, T), np.nan), "alive": np.zeros((S, T), bool)}
     active = np.ones(S, dtype=bool)

@@ -29,7 +29,7 @@ import numpy as np
 
 from .neproblem import NEProblem
 from .net.layers import Module
-from .net.rl import alive_bonus_for_step, reset_env, take_step_in_env
+from .net.rl import alive_bonus_for_step_host, reset_env, take_step_in_env
 from .net.runningnorm import RunningStat
 
 __all__ = ["GymNE"]
@@ -194,7 +194,9 @@ class GymNE(NEProblem):
             self._interaction_count += 1
             reward = reward - decrease
             if self._alive_bonus_schedule is not None and not done:
-                reward += float(alive_bonus_for_step(t, self._alive_bonus_schedule))
+                # host loop, host t: pure-python bonus — the jnp form would
+                # dispatch + sync a device scalar every single env step
+                reward += alive_bonus_for_step_host(t, self._alive_bonus_schedule)
             cumulative += reward
             if visualize and hasattr(env, "render"):
                 env.render()

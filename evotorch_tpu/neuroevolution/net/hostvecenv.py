@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .rl import alive_bonus_for_step
+from .rl import alive_bonus_for_step_host
 from .vecrl import reset_tensors
 
 __all__ = ["SyncVectorEnv", "run_host_vectorized_rollout"]
@@ -232,9 +232,11 @@ def run_host_vectorized_rollout(
 
         rewards = rewards - decrease_rewards_by
         if alive_bonus_schedule is not None:
+            # host loop, host step counters: pure-python bonus — the jnp form
+            # would dispatch + sync one device scalar per active lane per step
             for i in lanes[active & ~dones]:
-                rewards[i] += float(
-                    alive_bonus_for_step(int(steps_in_episode[i]), alive_bonus_schedule)
+                rewards[i] += alive_bonus_for_step_host(
+                    int(steps_in_episode[i]), alive_bonus_schedule
                 )
         scores[active] += rewards[active]
 

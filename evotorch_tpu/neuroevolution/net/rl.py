@@ -21,6 +21,7 @@ __all__ = [
     "ObsNormWrapperModule",
     "ActClipWrapperModule",
     "alive_bonus_for_step",
+    "alive_bonus_for_step_host",
     "reset_env",
     "take_step_in_env",
 ]
@@ -89,6 +90,23 @@ def alive_bonus_for_step(t, alive_bonus_schedule) -> float:
     t0, t1, bonus = alive_bonus_schedule
     ramp = bonus * (t - t0) / max(t1 - t0, 1)
     return jnp.clip(ramp, 0.0, bonus) * (t >= t0)
+
+
+def alive_bonus_for_step_host(t: int, alive_bonus_schedule) -> float:
+    """Pure-Python :func:`alive_bonus_for_step` for host gym/vector loops:
+    the jnp form dispatches a device computation whose scalar result the
+    host loop would then sync back EVERY step (graftlint ``host-sync``) —
+    for a host-side ``t`` the schedule is plain float math."""
+    if alive_bonus_schedule is None:
+        return 0.0
+    if len(alive_bonus_schedule) == 2:
+        t0, bonus = alive_bonus_schedule
+        return float(bonus) if t >= t0 else 0.0
+    t0, t1, bonus = alive_bonus_schedule
+    if t < t0:
+        return 0.0
+    ramp = float(bonus) * (t - t0) / max(t1 - t0, 1)
+    return min(max(ramp, 0.0), float(bonus))
 
 
 # --------------------------------------------------------------------------

@@ -9,6 +9,8 @@ from _common import setup_platform
 
 args = setup_platform()
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -31,7 +33,7 @@ def main():
         stdev_max_change=0.2,
     )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0,))
     def run(state, key):
         def gen(state, key):
             pop = cem_ask(key, state, popsize=50)

@@ -1,0 +1,15 @@
+#!/bin/bash
+# graftlint entry point: run the JAX correctness/performance static-analysis
+# suite (evotorch_tpu/analysis) over the gated surface — evotorch_tpu/,
+# bench*.py, examples/, __graft_entry__.py and scripts/*.py — and exit
+# non-zero on any non-baselined finding (or stale baseline entry).
+#
+# Pure-AST: finishes in a few seconds, never touches a jax backend, safe with
+# the TPU tunnel down. Pass extra args through (e.g. --no-baseline to see the
+# grandfathered findings, --checkers prng,retrace for a subset).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+# force the CPU platform config for the unavoidable `import jax` at package
+# import: the linter itself never initializes a backend, but the axon plugin
+# pins the platform at interpreter startup (see CLAUDE.md)
+exec env JAX_PLATFORMS=cpu python -m evotorch_tpu.analysis "$@"

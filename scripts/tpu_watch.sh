@@ -10,6 +10,8 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=bench_curves/tpu_r5
 mkdir -p "$OUT"
+# watcher heartbeats are operational noise, not results: the log lives at an
+# UNTRACKED path (gitignored) so probe lines never churn a round's commit
 LOG="$OUT/watch.log"
 PROBE_SECONDS=${PROBE_SECONDS:-180}
 DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-11} * 3600 ))

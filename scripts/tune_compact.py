@@ -18,6 +18,7 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -56,9 +57,7 @@ def main():
     policy = build_policy(env)
     stats = RunningNorm(env.observation_size).stats
     state = fresh_pgpe_state(policy.parameter_count)
-    values = jax.jit(lambda k, s: pgpe_ask(k, s, popsize=popsize))(
-        jax.random.key(0), state
-    )
+    values = jax.jit(partial(pgpe_ask, popsize=popsize))(jax.random.key(0), state)
     jax.block_until_ready(values)
     common = dict(num_episodes=1, episode_length=episode_length,
                   compute_dtype=compute_dtype)

@@ -1,0 +1,489 @@
+"""graftlint: per-checker unit tests on synthetic sources, plus the repo
+gate — the whole linted surface must carry zero non-baselined findings (and
+no stale baseline entries), so any new PRNG-reuse / retrace / host-sync /
+donation / axis-name / dtype hazard fails the fast tier at the moment it is
+introduced."""
+
+import textwrap
+
+from evotorch_tpu.analysis import (
+    apply_baseline,
+    default_baseline_path,
+    lint_sources,
+    load_baseline,
+    run_lint,
+)
+
+
+def _lint(src, path="mod.py", checkers=None, extra=None):
+    sources = {path: textwrap.dedent(src)}
+    if extra:
+        sources.update({k: textwrap.dedent(v) for k, v in extra.items()})
+    return lint_sources(sources, checkers=checkers)
+
+
+def _checkers(findings):
+    return [f.checker for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# prng
+# ---------------------------------------------------------------------------
+
+
+def test_prng_flags_double_consumption():
+    findings = _lint(
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return a + b
+        """,
+        checkers=["prng"],
+    )
+    assert _checkers(findings) == ["prng"]
+    assert "key" in findings[0].message
+
+
+def test_prng_flags_loop_reuse():
+    findings = _lint(
+        """
+        import jax
+
+        def f(key):
+            out = []
+            for i in range(4):
+                out.append(jax.random.normal(key, (3,)))
+            return out
+        """,
+        checkers=["prng"],
+    )
+    assert _checkers(findings) == ["prng"]
+    assert "loop" in findings[0].message
+
+
+def test_prng_accepts_fresh_key_per_loop_iteration():
+    # `for k in split(key, n)` hands a NEW key to every iteration — the
+    # canonical batching idiom must not read as cross-iteration reuse
+    findings = _lint(
+        """
+        import jax
+
+        def f(key):
+            out = []
+            for k in jax.random.split(key, 4):
+                out.append(jax.random.normal(k, (3,)))
+            return out
+        """,
+        checkers=["prng"],
+    )
+    assert findings == []
+
+
+def test_prng_accepts_split_discipline():
+    findings = _lint(
+        """
+        import jax
+
+        def f(key):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, (3,))
+            b = jax.random.uniform(k2, (3,))
+            return a + b
+
+        def g(key):
+            for i in range(4):
+                key, sub = jax.random.split(key)
+                yield jax.random.normal(sub, (3,))
+
+        def h(key, interpret):
+            # mutually exclusive paths may both consume the same key
+            if interpret:
+                return jax.random.normal(key, (2,))
+            return jax.random.uniform(key, (2,))
+        """,
+        checkers=["prng"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# retrace
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_flags_jit_in_loop_and_fresh_callees():
+    findings = _lint(
+        """
+        import jax
+
+        def bench(env):
+            for n in (1, 2, 3):
+                f = jax.jit(lambda x: x * n)    # jit-in-loop
+                f(n)
+
+        def harness(env, x):
+            step = jax.jit(env.batch_step)      # fresh bound method
+            fwd = jax.jit(lambda a: a + 1)      # fresh lambda
+            return step(x), fwd(x)
+        """,
+        checkers=["retrace"],
+    )
+    details = sorted(f.detail for f in findings)
+    assert details == [
+        "jit-fresh-callee:env.batch_step",
+        "jit-fresh-callee:lambda",
+        "jit-in-loop",
+    ]
+
+
+def test_retrace_accepts_cached_builders_and_module_scope():
+    findings = _lint(
+        """
+        import functools
+        import jax
+
+        _CACHE = {}
+
+        def get(env):
+            fn = _CACHE.get(env)
+            if fn is None:
+                fn = jax.jit(env.batch_step)
+                _CACHE[env] = fn
+            return fn
+
+        @functools.lru_cache(maxsize=8)
+        def build(env):
+            return jax.jit(lambda s, a: env.step(s, a))
+
+        top = jax.jit(lambda x: x + 1)  # module scope: built once per import
+
+        def warm(envs):
+            # cache-filling warm-up loop: one jit per cache key, not per call
+            for env in envs:
+                _CACHE[env] = jax.jit(env.batch_step)
+        """,
+        checkers=["retrace"],
+    )
+    assert findings == []
+
+
+def test_retrace_cache_exemption_matches_real_memoizers_only():
+    # a decorator merely NAMED like a cache does not memoize: the fresh
+    # bound-method jit under it must still be reported
+    findings = _lint(
+        """
+        import jax
+
+        def clear_cache(fn):
+            return fn
+
+        @clear_cache
+        def harness(env, x):
+            step = jax.jit(env.batch_step)
+            return step(x)
+        """,
+        checkers=["retrace"],
+    )
+    assert [f.detail for f in findings] == ["jit-fresh-callee:env.batch_step"]
+
+
+def test_retrace_flags_fstring_args_to_jitted_callable():
+    findings = _lint(
+        """
+        import jax
+
+        run = jax.jit(lambda tag, x: x)
+
+        def f(x, i):
+            return run(f"step{i}", x)
+        """,
+        checkers=["retrace"],
+    )
+    assert [f.detail for f in findings] == ["str-arg:run"]
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_flags_traced_conversions():
+    findings = _lint(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return float(x) * 2
+
+        @jax.jit
+        def g(x):
+            return np.asarray(x).sum()
+
+        def h(x):
+            return jax.lax.while_loop(lambda c: c[0].item() < 3, body, x)
+
+        def body(c):
+            return c
+        """,
+        checkers=["host-sync"],
+    )
+    details = sorted(f.detail for f in findings)
+    assert details == ["float-in-trace", "item", "np-asarray"]
+
+
+def test_host_sync_exempts_static_args_and_shapes():
+    findings = _lint(
+        """
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, static_argnames=("n", "mode"))
+        def f(x, n, mode):
+            k = int(n) + len(mode)
+            m = int(x.shape[0])
+            return x[: k + m]
+        """,
+        checkers=["host-sync"],
+    )
+    assert findings == []
+
+
+def test_host_sync_flags_per_iteration_device_sync_in_host_loop():
+    helper = """
+        import jax.numpy as jnp
+
+        def bonus(t, schedule):
+            return jnp.where(t >= schedule[0], schedule[1], 0.0)
+        """
+    findings = _lint(
+        """
+        from helper import bonus
+
+        def rollout(schedule):
+            total = 0.0
+            for t in range(100):
+                total += float(bonus(t, schedule))
+            return total
+        """,
+        checkers=["host-sync"],
+        extra={"helper.py": helper},
+    )
+    assert [f.detail for f in findings] == ["loop-sync:bonus"]
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+
+def test_donation_flags_undonated_state_steps():
+    findings = _lint(
+        """
+        import jax
+
+        def tell(state, values, evals):
+            return state
+
+        @jax.jit
+        def step(state, key):
+            return state
+
+        def main():
+            tell_jit = jax.jit(tell)
+            return tell_jit
+        """,
+        checkers=["donation"],
+    )
+    details = sorted(f.detail for f in findings)
+    assert details == ["undonated-state:step", "undonated-state:tell"]
+
+
+def test_donation_resolves_cross_module_aliases():
+    algo = """
+        def pgpe_tell(state, values, evals):
+            return state
+        """
+    findings = _lint(
+        """
+        import jax
+
+        from algo import pgpe_tell
+
+        def main(lowrank):
+            if lowrank:
+                tell = pgpe_tell
+            else:
+                tell = pgpe_tell
+            tell_jit = jax.jit(tell)
+            return tell_jit
+
+        def chained():
+            a = b = pgpe_tell  # chained alias: both names must resolve
+            return jax.jit(b)
+        """,
+        checkers=["donation"],
+        extra={"algo.py": algo},
+    )
+    assert sorted(f.detail for f in findings) == [
+        "undonated-state:b",
+        "undonated-state:tell",
+    ]
+
+
+def test_donation_accepts_donated_or_non_state_firsts():
+    findings = _lint(
+        """
+        from functools import partial
+
+        import jax
+
+        def tell(state, values):
+            return state
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, key):
+            return state
+
+        @jax.jit
+        def evaluate(values, key):
+            return values
+
+        def main():
+            return jax.jit(tell, donate_argnums=(0,))
+        """,
+        checkers=["donation"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# axis-name
+# ---------------------------------------------------------------------------
+
+
+def test_axis_name_flags_undeclared_literals():
+    findings = _lint(
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), axis_names=("pop",))
+
+        def local(x):
+            good = jax.lax.pmean(x, "pop")
+            bad = jax.lax.psum(x, "popp")
+            spec = P("batch")
+            return good + bad, spec
+        """,
+        checkers=["axis-name"],
+    )
+    details = sorted(f.detail for f in findings)
+    assert details == ["unknown-axis:batch", "unknown-axis:popp"]
+
+
+def test_axis_name_collects_defaults_and_make_mesh():
+    findings = _lint(
+        """
+        import jax
+
+        def make_mesh(shape):
+            ...
+
+        def helper(x, axis_name="pop"):
+            return jax.lax.pmean(x, axis_name)
+
+        def entry(x):
+            mesh = make_mesh({"pop": 4, "model": 2})
+            return jax.lax.pmean(jax.lax.psum(x, "model"), "pop")
+        """,
+        checkers=["axis-name"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# dtype
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_flags_x64_references():
+    findings = _lint(
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        BAD = jnp.float64
+
+        def f(x):
+            return jnp.asarray(x, dtype="float64")
+
+        @jax.jit
+        def g(x):
+            return x * np.float64(2.0)
+
+        def host():
+            return np.float64(1.0)  # host-side: allowed
+        """,
+        checkers=["dtype"],
+    )
+    details = sorted(f.detail for f in findings)
+    assert details == ["dtype-str:float64", "np-x64:float64", "x64:float64"]
+
+
+def test_dtype_flags_enable_x64():
+    findings = _lint(
+        """
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        """,
+        checkers=["dtype"],
+    )
+    assert [f.detail for f in findings] == ["enable-x64"]
+
+
+# ---------------------------------------------------------------------------
+# the repo gate
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_clean_modulo_baseline():
+    """The acceptance gate: zero non-baselined findings on the whole linted
+    surface, and no stale baseline entries (fixed findings must drop their
+    grandfathering in the same change)."""
+    findings = run_lint()
+    baseline = load_baseline(default_baseline_path())
+    new, stale = apply_baseline(findings, baseline)
+    assert new == [], "non-baselined graftlint findings:\n" + "\n".join(
+        f.format() for f in new
+    )
+    assert stale == [], "stale baseline entries (remove them):\n" + "\n".join(
+        e["signature"] for e in stale
+    )
+
+
+def test_baseline_is_multiset_matched():
+    findings = _lint(
+        """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            c = jax.random.gumbel(key, (3,))
+            return a + b + c
+        """,
+        checkers=["prng"],
+    )
+    assert len(findings) == 2  # second and third consumption
+    one_entry = [{"signature": findings[0].signature}]
+    new, stale = apply_baseline(findings, one_entry)
+    assert len(new) == 1 and stale == []

@@ -1,0 +1,225 @@
+"""Runtime retrace sentinel: steady-state compile counts for the hot paths.
+
+Every test follows the same shape: warm the program up (first call compiles),
+run more generations of IDENTICAL shape, and assert with
+``assert_compiles(0)`` that the steady state never re-traces. These guard the
+contract the whole framework is built on — "stays compiled, stays on
+device" — for all four eval contracts and the jitted PGPE/SNES ask-tell
+steps; any change that starts recompiling per generation fails here, in the
+fast tier.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evotorch_tpu.algorithms.functional import (
+    pgpe,
+    pgpe_ask,
+    pgpe_tell,
+    snes,
+    snes_ask,
+    snes_tell,
+)
+from evotorch_tpu.analysis import RetraceError, assert_compiles, track_compiles
+from evotorch_tpu.envs import CartPole
+from evotorch_tpu.neuroevolution.net import (
+    FlatParamsPolicy,
+    Linear,
+    Tanh,
+    run_vectorized_rollout,
+    run_vectorized_rollout_compacting,
+)
+from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+
+POPSIZE = 8
+EPISODE_LENGTH = 16
+
+
+def _env_policy():
+    env = CartPole()
+    net = Linear(env.observation_size, env.action_size) >> Tanh()
+    return env, FlatParamsPolicy(net)
+
+
+def _pgpe_state(n_params: int):
+    return pgpe(
+        center_init=jnp.zeros(n_params),
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        objective_sense="max",
+        stdev_init=0.1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the sentinel itself
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_canary_detects_fresh_compiles():
+    """If jax's compile-log format ever drifts, the sentinel would silently
+    count zero and every steady-state assertion would pass vacuously — this
+    canary fails instead."""
+    f = jax.jit(lambda x: x * 2.0 + 1.0)
+    with track_compiles() as log:
+        f(jnp.ones(7))
+    assert log.count >= 1, "sentinel missed a guaranteed fresh compile"
+    assert log.count_matching("<lambda>") == 1
+    with track_compiles() as warm:
+        f(jnp.ones(7))
+    assert warm.count == 0, f"warm call recompiled: {warm.names}"
+
+
+def test_sentinel_assert_compiles_raises():
+    f = jax.jit(lambda x: x - 3.0)
+    x11, x13 = jnp.ones(11), jnp.ones(13)  # their own tiny compiles stay outside
+    with pytest.raises(RetraceError):
+        with assert_compiles(0):
+            f(x11)
+    # the budgeted + name-filtered form passes: one compile of f itself
+    with assert_compiles(1, match="<lambda>"):
+        f(x13)
+
+
+# ---------------------------------------------------------------------------
+# eval contracts: one compile, then steady state
+# ---------------------------------------------------------------------------
+
+
+def _generation_fn(env, policy, eval_mode, **rollout_kwargs):
+    stats = RunningNorm(env.observation_size).stats
+
+    def generation(state, key):
+        k1, k2 = jax.random.split(key)
+        values = pgpe_ask(k1, state, popsize=POPSIZE)
+        result = run_vectorized_rollout(
+            env,
+            policy,
+            values,
+            k2,
+            stats,
+            num_episodes=1,
+            episode_length=EPISODE_LENGTH,
+            eval_mode=eval_mode,
+            **rollout_kwargs,
+        )
+        state = pgpe_tell(state, values, result.scores)
+        return state, result.scores
+
+    return jax.jit(generation, donate_argnums=(0,))
+
+
+@pytest.mark.parametrize(
+    "eval_mode,kwargs",
+    [
+        ("budget", {}),
+        ("episodes", {}),
+        ("episodes_refill", {"refill_width": 4}),
+    ],
+)
+def test_eval_contract_steady_state(eval_mode, kwargs):
+    env, policy = _env_policy()
+    gen = _generation_fn(env, policy, eval_mode, **kwargs)
+    state = _pgpe_state(policy.parameter_count)
+    key = jax.random.key(0)
+
+    # warmup: exactly one compile of the generation program
+    with track_compiles() as log:
+        key, sub = jax.random.split(key)
+        state, scores = gen(state, sub)
+        jax.block_until_ready(scores)
+    assert log.count_matching("generation") == 1, log.names
+
+    # second call settles any remaining first-use programs (donation reuse)
+    key, sub = jax.random.split(key)
+    state, scores = gen(state, sub)
+    jax.block_until_ready(scores)
+
+    # steady state: ZERO compiles of any kind across further generations
+    with assert_compiles(0):
+        for _ in range(2):
+            key, sub = jax.random.split(key)
+            state, scores = gen(state, sub)
+            jax.block_until_ready(scores)
+
+
+def test_eval_contract_steady_state_episodes_compact():
+    """The host-orchestrated compacting runner: its jitted building blocks
+    (init/chunk/compact/finalize) are cached per config, so generations after
+    the first must not trace anything new."""
+    env, policy = _env_policy()
+    stats = RunningNorm(env.observation_size).stats
+    ask_jit = jax.jit(partial(pgpe_ask, popsize=POPSIZE))
+    tell_jit = jax.jit(pgpe_tell, donate_argnums=(0,))
+    state = _pgpe_state(policy.parameter_count)
+    key = jax.random.key(0)
+
+    def generation(state, key):
+        k1, k2 = jax.random.split(key)
+        values = ask_jit(k1, state)
+        result = run_vectorized_rollout_compacting(
+            env,
+            policy,
+            values,
+            k2,
+            stats,
+            num_episodes=1,
+            episode_length=EPISODE_LENGTH,
+        )
+        state = tell_jit(state, values, result.scores)
+        return state, result.scores
+
+    for _ in range(2):  # warmup: compile + settle
+        key, sub = jax.random.split(key)
+        state, scores = generation(state, sub)
+        jax.block_until_ready(scores)
+
+    with assert_compiles(0):
+        for _ in range(2):
+            key, sub = jax.random.split(key)
+            state, scores = generation(state, sub)
+            jax.block_until_ready(scores)
+
+
+# ---------------------------------------------------------------------------
+# functional ask-tell steps (PGPE / SNES) on a plain fitness function
+# ---------------------------------------------------------------------------
+
+
+def _sphere(values):
+    return -jnp.sum(values**2, axis=-1)
+
+
+@pytest.mark.parametrize("algo", ["pgpe", "snes"])
+def test_ask_tell_step_steady_state(algo):
+    if algo == "pgpe":
+        state = _pgpe_state(12)
+        ask, tell = pgpe_ask, pgpe_tell
+    else:
+        state = snes(center_init=jnp.zeros(12), objective_sense="max", stdev_init=0.1)
+        ask, tell = snes_ask, snes_tell
+
+    def step(state, key):
+        values = ask(key, state, popsize=POPSIZE)
+        return tell(state, values, _sphere(values))
+
+    step_jit = jax.jit(step, donate_argnums=(0,))
+    key = jax.random.key(1)
+
+    with track_compiles() as log:
+        key, sub = jax.random.split(key)
+        state = step_jit(state, sub)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    assert log.count_matching("step") == 1, log.names
+
+    key, sub = jax.random.split(key)
+    state = step_jit(state, sub)
+
+    with assert_compiles(0):
+        for _ in range(3):
+            key, sub = jax.random.split(key)
+            state = step_jit(state, sub)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
