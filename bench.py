@@ -19,6 +19,15 @@ picks which one is the line's primary ``value``; ``compaction_speedup`` and
 ``refill_speedup`` are the in-run A/Bs against monolithic ``episodes``.
 
 ``vs_baseline`` = env_steps_per_sec / 1_000_000 (the north-star target).
+
+``BENCH_BACKEND=mujoco`` additionally measures the REAL-MuJoCo host path
+(``MjVecEnv`` over ``mujoco.rollout``): the PR-2 synchronous fixed-chunk loop
+vs the Sebulba-style pipelined refill scheduler, reported as
+``mj_sync_steps_per_sec`` / ``mj_steps_per_sec`` / ``mj_pipeline_speedup``
+columns on the same JSON line (knobs: ``BENCH_MJ_ENV``, ``BENCH_MJ_POPSIZE``,
+``BENCH_MJ_NUM_ENVS``, ``BENCH_MJ_EPISODE_LENGTH``, ``BENCH_MJ_BLOCKS``,
+``BENCH_MJ_REPEATS`` — median of N, this box times ±20% run-to-run —
+``EVOTORCH_MJ_NTHREAD``). Off by default: the bespoke-sim line is unchanged.
 """
 
 import json
@@ -32,6 +41,7 @@ from bench_common import (
     build_policy,
     compact_kwargs,
     fresh_pgpe_state,
+    measure_mujoco,
     refill_kwargs,
     setup_backend,
 )
@@ -184,30 +194,32 @@ def main():
             return None
         return round(modes[mode]["value"] / modes["episodes"]["value"], 3)
 
-    print(
-        json.dumps(
-            {
-                "metric": "pgpe_vectorized_rollout_env_steps_per_sec",
-                "value": primary["value"],
-                "unit": "env_steps/sec",
-                "vs_baseline": primary["vs_baseline"],
-                "generations_per_sec": primary["generations_per_sec"],
-                "episodes_mode_value": modes[episodes_key]["value"],
-                "episodes_mode_vs_baseline": modes[episodes_key]["vs_baseline"],
-                "compaction_speedup": speedup_vs_episodes("episodes_compact"),
-                "refill_speedup": speedup_vs_episodes("episodes_refill"),
-                "modes": modes,
-                "env": cfg["env_name"],
-                "env_args": cfg["env_kwargs"],
-                "popsize": popsize,
-                "episode_length": episode_length,
-                "eval_mode": eval_mode,
-                "lowrank": lowrank,
-                "compute_dtype": str(compute_dtype.__name__ if compute_dtype else "float32"),
-                "backend": "cpu-fallback" if use_cpu else "tpu",
-            }
-        )
-    )
+    line = {
+        "metric": "pgpe_vectorized_rollout_env_steps_per_sec",
+        "value": primary["value"],
+        "unit": "env_steps/sec",
+        "vs_baseline": primary["vs_baseline"],
+        "generations_per_sec": primary["generations_per_sec"],
+        "episodes_mode_value": modes[episodes_key]["value"],
+        "episodes_mode_vs_baseline": modes[episodes_key]["vs_baseline"],
+        "compaction_speedup": speedup_vs_episodes("episodes_compact"),
+        "refill_speedup": speedup_vs_episodes("episodes_refill"),
+        "modes": modes,
+        "env": cfg["env_name"],
+        "env_args": cfg["env_kwargs"],
+        "popsize": popsize,
+        "episode_length": episode_length,
+        "eval_mode": eval_mode,
+        "lowrank": lowrank,
+        "compute_dtype": str(compute_dtype.__name__ if compute_dtype else "float32"),
+        "backend": "cpu-fallback" if use_cpu else "tpu",
+    }
+    if cfg["mj_backend"]:
+        # BENCH_BACKEND=mujoco: append the real-MuJoCo host-path columns
+        # (sync chunked loop vs pipelined refill scheduler over MjVecEnv);
+        # off by default so the line above stays byte-compatible
+        line.update(measure_mujoco(cfg))
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

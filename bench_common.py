@@ -83,6 +83,25 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
             else None
         ),
         "refill_period": int(os.environ.get("BENCH_REFILL_PERIOD", "1")),
+        # BENCH_BACKEND=mujoco: ALSO measure the real-MuJoCo host path (sync
+        # chunked loop vs the pipelined refill scheduler) and append the
+        # mj_* columns to the JSON line. Default off: the four bespoke-sim
+        # contracts and their output stay byte-compatible.
+        "mj_backend": os.environ.get("BENCH_BACKEND", "") == "mujoco",
+        "mj_env": os.environ.get("BENCH_MJ_ENV", "Hopper-v5"),
+        # 512 is past the refill crossover on this box (the drain tail — one
+        # straggler's worth of low-occupancy rounds per eval — amortizes with
+        # popsize; bench_curves/hopper_v5_pipeline_r7.json has 256 vs 512)
+        "mj_popsize": int(os.environ.get("BENCH_MJ_POPSIZE", "512")),
+        "mj_num_envs": int(os.environ.get("BENCH_MJ_NUM_ENVS", "32")),
+        # the env's own -v5 horizon (1000): no artificial cap — straggler
+        # episodes are exactly what separates the two schedulers
+        "mj_episode_length": int(os.environ.get("BENCH_MJ_EPISODE_LENGTH", "1000")),
+        # None = the scheduler's auto block split (2 when >1 core, else 1)
+        "mj_blocks": (
+            int(os.environ["BENCH_MJ_BLOCKS"]) if "BENCH_MJ_BLOCKS" in os.environ else None
+        ),
+        "mj_repeats": int(os.environ.get("BENCH_MJ_REPEATS", "1")),
     }
 
 
@@ -106,18 +125,148 @@ def refill_kwargs(cfg: dict, *, n_shards: int = 1) -> dict:
     return kwargs
 
 
+def _bench_mlp(obs_dim: int, act_dim: int):
+    """The BENCH_HIDDEN-sized MLP, shared by every bench policy builder so
+    the bespoke-sim contracts and the real-MuJoCo A/B cannot silently bench
+    different architectures."""
+    from evotorch_tpu.neuroevolution.net import Linear, Tanh
+
+    hidden = [int(h) for h in os.environ.get("BENCH_HIDDEN", "64,64").split(",") if h]
+    net = Linear(obs_dim, hidden[0])
+    for a, b in zip(hidden, hidden[1:] + [None]):
+        net = net >> Tanh()
+        net = net >> Linear(a, b if b is not None else act_dim)
+    return net
+
+
 def build_policy(env):
     """The benchmark policy: an MLP sized by BENCH_HIDDEN (default "64,64" —
     the MXU-headroom knob; ES rollouts are env-bound, so the policy can grow
     orders of magnitude before it shows up in steps/s)."""
-    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy
 
-    hidden = [int(h) for h in os.environ.get("BENCH_HIDDEN", "64,64").split(",") if h]
-    net = Linear(env.observation_size, hidden[0])
-    for a, b in zip(hidden, hidden[1:] + [None]):
-        net = net >> Tanh()
-        net = net >> Linear(a, b if b is not None else env.action_size)
-    return FlatParamsPolicy(net)
+    return FlatParamsPolicy(_bench_mlp(env.observation_size, env.action_size))
+
+
+def measure_mujoco(cfg: dict) -> dict:
+    """Real-MuJoCo host-path A/B: env-steps/sec of the PR-2 synchronous
+    fixed-chunk loop vs the pipelined refill scheduler, same `MjVecEnv`,
+    same population (aggressive random linear policies — the skewed
+    episode-length regime evaluation actually sees at init). Returns the
+    ``mj_*`` columns bench.py appends behind ``BENCH_BACKEND=mujoco``."""
+    import time
+
+    import gymnasium as gym
+    import jax.numpy as jnp
+    import numpy as np
+
+    from evotorch_tpu.envs.mujoco.mjvecenv import MjVecEnv
+    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy
+    from evotorch_tpu.neuroevolution.net.hostvecenv import (
+        run_host_pipelined_rollout,
+        run_host_vectorized_rollout,
+    )
+
+    env_id = cfg["mj_env"]
+    popsize = cfg["mj_popsize"]
+    num_envs = cfg["mj_num_envs"]
+    episode_length = cfg["mj_episode_length"]
+    num_blocks = cfg["mj_blocks"]
+
+    probe = gym.make(env_id)
+    obs_dim = int(np.prod(probe.observation_space.shape))
+    act_dim = int(np.prod(probe.action_space.shape))
+    probe.close()
+    policy = FlatParamsPolicy(_bench_mlp(obs_dim, act_dim))
+    rng = np.random.default_rng(0)
+    params = jnp.asarray(
+        rng.normal(size=(popsize, policy.parameter_count)), jnp.float32
+    )
+
+    def fresh_vec():
+        vec = MjVecEnv(lambda: gym.make(env_id), num_envs)
+        vec.seed(range(1000, 1000 + num_envs))
+        return vec
+
+    def run_sync_chunked(vec):
+        total = 0
+        for start in range(0, popsize, num_envs):
+            result = run_host_vectorized_rollout(
+                vec,
+                policy,
+                params[start : start + num_envs],
+                num_episodes=1,
+                episode_length=episode_length,
+            )
+            total += result["interactions"]
+        return total
+
+    def run_pipelined(vec):
+        result = run_host_pipelined_rollout(
+            vec,
+            policy,
+            params,
+            num_episodes=1,
+            episode_length=episode_length,
+            mode="pipelined",
+            num_blocks=num_blocks,
+        )
+        return result["interactions"]
+
+    # warmup: compile every jit signature the TIMED runs will hit. The
+    # gathered forward is keyed on the FULL (popsize, L) params shape, so the
+    # pipelined warmup must pass the whole matrix; the chunked loop's forward
+    # is keyed on chunk width, so warm the full chunk and (if popsize is not
+    # a multiple of num_envs) the short final chunk too.
+    vec = fresh_vec()
+    run_host_vectorized_rollout(
+        vec, policy, params[:num_envs], num_episodes=1, episode_length=3
+    )
+    if popsize % num_envs:
+        run_host_vectorized_rollout(
+            vec, policy, params[: popsize % num_envs], num_episodes=1, episode_length=3
+        )
+    run_host_pipelined_rollout(
+        vec,
+        policy,
+        params,
+        num_episodes=1,
+        episode_length=3,
+        mode="pipelined",
+        num_blocks=num_blocks,
+    )
+    vec.close()
+
+    out = {}
+    repeats = cfg.get("mj_repeats", 1)
+    for name, runner in (("sync", run_sync_chunked), ("pipelined", run_pipelined)):
+        rates = []
+        for _ in range(repeats):
+            vec = fresh_vec()
+            t0 = time.perf_counter()
+            steps = runner(vec)
+            elapsed = time.perf_counter() - t0
+            vec.close()
+            rates.append(steps / elapsed)
+            print(
+                f"[mujoco/{name}] {steps} env-steps in {elapsed:.2f}s "
+                f"({steps / elapsed:.0f} steps/s)",
+                file=sys.stderr,
+            )
+        out[name] = {"steps_per_sec": sorted(rates)[len(rates) // 2]}
+
+    return {
+        "mj_env": env_id,
+        "mj_popsize": popsize,
+        "mj_num_envs": num_envs,
+        "mj_episode_length": episode_length,
+        "mj_blocks": num_blocks,
+        "mj_sync_steps_per_sec": round(out["sync"]["steps_per_sec"], 1),
+        "mj_steps_per_sec": round(out["pipelined"]["steps_per_sec"], 1),
+        "mj_pipeline_speedup": round(
+            out["pipelined"]["steps_per_sec"] / out["sync"]["steps_per_sec"], 3
+        ),
+    }
 
 
 def fresh_pgpe_state(parameter_count: int):

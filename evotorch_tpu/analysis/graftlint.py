@@ -19,12 +19,21 @@ Baselines: a finding's :attr:`Finding.signature` deliberately excludes the
 line number, so unrelated edits moving code around do not churn
 ``baseline.json``; matching is multiset-aware (two identical-signature
 findings need two baseline entries).
+
+Scoped exemptions: a ``# graftlint: allow(<checker>): <reason>`` comment on
+(or immediately above) the offending line suppresses that checker there —
+the in-code alternative to a baseline entry for *intentional* violations
+(e.g. the host pipeline's swap-point syncs). The reason is mandatory: a
+reasonless allow is itself reported as a ``lint-allow`` finding.
 """
 
 from __future__ import annotations
 
 import ast
+import io
 import json
+import re
+import tokenize
 from collections import Counter
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -42,6 +51,7 @@ __all__ = [
     "default_targets",
     "default_baseline_path",
     "repo_root",
+    "scoped_allows",
 ]
 
 
@@ -126,6 +136,70 @@ def apply_baseline(
             stale.append(e)
             seen[sig] += 1
     return new, stale
+
+
+# ---------------------------------------------------------------------------
+# scoped allow-comments
+# ---------------------------------------------------------------------------
+
+#: `# graftlint: allow(checker[, checker...])` with an optional `: reason`
+_ALLOW_RE = re.compile(
+    r"#\s*graftlint:\s*allow\(\s*([a-z0-9_\-\s,]+?)\s*\)\s*(?::\s*(\S.*))?$"
+)
+
+
+def scoped_allows(path: str, source: str) -> Tuple[Dict[int, set], List[Finding]]:
+    """Parse ``# graftlint: allow(...)`` comments (real COMMENT tokens only —
+    allow-syntax inside string literals is inert). Returns
+    ``({line: {checker, ...}}, reasonless-allow findings)``. A trailing allow
+    covers its own line; a standalone allow-comment line covers the next
+    line — never both, so one allow cannot silently wave through an
+    adjacent, unrelated violation."""
+    allows: Dict[int, set] = {}
+    problems: List[Finding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return allows, problems  # unparsable source is reported elsewhere
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _ALLOW_RE.search(tok.string)
+        if m is None:
+            continue
+        lineno = tok.start[0]
+        checkers = {c.strip() for c in m.group(1).split(",") if c.strip()}
+        if not m.group(2):
+            problems.append(
+                Finding(
+                    checker="lint-allow",
+                    path=path,
+                    line=lineno,
+                    symbol="<comment>",
+                    message=(
+                        "graftlint allow-comment without a reason — write"
+                        " `# graftlint: allow(<checker>): <why this is"
+                        " intentional>`"
+                    ),
+                    detail="missing-reason",
+                )
+            )
+            continue
+        trailing = bool(tok.line[: tok.start[1]].strip())  # code before the '#'
+        covered = lineno if trailing else lineno + 1
+        allows.setdefault(covered, set()).update(checkers)
+    return allows, problems
+
+
+def _apply_scoped_allows(
+    findings: List[Finding], allows_by_path: Dict[str, Dict[int, set]]
+) -> List[Finding]:
+    kept = []
+    for f in findings:
+        allowed = allows_by_path.get(f.path, {}).get(f.line, ())
+        if f.checker not in allowed:
+            kept.append(f)
+    return kept
 
 
 # ---------------------------------------------------------------------------
@@ -382,7 +456,11 @@ def lint_sources(
 
     modules = []
     findings: List[Finding] = []
+    allows_by_path: Dict[str, Dict[int, set]] = {}
     for path, src in sources.items():
+        allows, allow_problems = scoped_allows(path, src)
+        allows_by_path[path] = allows
+        findings.extend(allow_problems)
         try:
             modules.append(ModuleInfo.parse(path, src))
         except SyntaxError as e:
@@ -402,6 +480,7 @@ def lint_sources(
             if checkers is not None and name not in checkers:
                 continue
             findings.extend(check(mod, project))
+    findings = _apply_scoped_allows(findings, allows_by_path)
     findings.sort(key=lambda f: (f.path, f.line, f.checker))
     return findings
 

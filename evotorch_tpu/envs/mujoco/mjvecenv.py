@@ -20,11 +20,18 @@ gymnasium's. The v5 reward/termination math below is transcribed from
 observations) against real ``env.step`` lanes in ``tests/test_mujoco.py``.
 
 The class is API-compatible with ``net.hostvecenv.SyncVectorEnv`` (``reset``
-/ ``step(actions, active)`` / ``_reset_one`` / ``seed`` / ``close``), so
-``run_host_vectorized_rollout`` — the batched-policy-forward loop where one
-device call serves the whole lane block per timestep — runs unchanged on real
-physics. Podracer (arXiv:2104.06272) motivates exactly this split: batched
-host-side physics feeding a device-side learner.
+/ ``step(actions, active)`` / ``_reset_one`` / ``seed`` / ``close``), so both
+host rollout engines run unchanged on real physics: the synchronous
+``run_host_vectorized_rollout`` loop and the Sebulba-style
+``run_host_pipelined_rollout`` scheduler (Podracer, arXiv:2104.06272 —
+batched host physics overlapping the device policy forward). Under the
+pipelined scheduler, ``step`` is called **block-sliced** (the ``active`` mask
+covers one lane block) from a single worker thread while the main thread may
+``_reset_one`` lanes of a *different* block; that is safe because every
+per-lane buffer (``_state`` rows, ``_steps``, the lane's own env) is touched
+by exactly one block at a time, and ``_pool.rollout`` copies its
+``_state[idx]`` slice per call. ``last_terms`` consequently reflects the most
+recent *block's* step, not the whole width, when pipelined.
 
 Envs outside the supported family table (or with non-default observation
 flags) fall back to the generic ``SyncVectorEnv`` via
@@ -250,7 +257,17 @@ class MjVecEnv:
         self._obs_dim = int(np.prod(env0.observation_space.shape))
 
         if nthread is None:
-            nthread = max(1, min(n, os.cpu_count() or 1))
+            # EVOTORCH_MJ_NTHREAD overrides the physics thread-pool width
+            # (mujoco.rollout's nthread). The default saturates the machine —
+            # which on a 1-core box means nthread=1, i.e. NO physics
+            # parallelism: the pipelined scheduler's overlap gains there come
+            # from lane refill, not threading (docs/neuroevolution.md).
+            env_nthread = os.environ.get("EVOTORCH_MJ_NTHREAD", "")
+            if env_nthread:
+                nthread = int(env_nthread)
+            else:
+                nthread = max(1, min(n, os.cpu_count() or 1))
+        self.nthread = int(nthread)
         self._pool = mj_rollout.Rollout(nthread=int(nthread))
         self._scratch = [mujoco.MjData(self._models[0]) for _ in range(int(nthread))]
         self.last_terms: Dict[str, np.ndarray] = {}
@@ -349,15 +366,17 @@ def _instantiate(env_fn, num_envs) -> List:
     return [item() if callable(item) else item for item in items]
 
 
-def make_host_vector_env(env_fn: Callable, num_envs: int):
+def make_host_vector_env(env_fn: Callable, num_envs: int, *, nthread: Optional[int] = None):
     """Backend chooser for ``GymNE``'s vectorized host evaluation: a real
     MuJoCo batched engine when the env is a supported ``-v5`` family, the
     generic lockstep ``SyncVectorEnv`` otherwise. The probe env is reused as
-    lane 0 either way (never constructed twice)."""
+    lane 0 either way (never constructed twice). ``nthread`` feeds
+    ``mujoco.rollout``'s thread pool (default: ``EVOTORCH_MJ_NTHREAD`` or
+    one thread per core)."""
     from ...neuroevolution.net.hostvecenv import SyncVectorEnv
 
     probe = env_fn()
     rest = [env_fn for _ in range(int(num_envs) - 1)]
     if _family_for(probe) is not None:
-        return MjVecEnv([probe] + rest)
+        return MjVecEnv([probe] + rest, nthread=nthread)
     return SyncVectorEnv([lambda: probe] + rest)

@@ -13,10 +13,15 @@ actor delta-sync becomes a local update here; multi-device users should use
 This class is deliberately host-side: it exists for parity with gym-API
 environments and for debugging policies; the TPU-native throughput path is
 ``VecNE`` over pure-JAX envs. With ``num_envs > 1`` the evaluation becomes
-lane-vectorized (one batched device forward per timestep), and for real
-MuJoCo ``-v5`` envs the lanes are stepped by the batched
-``envs.mujoco.MjVecEnv`` engine over ``mujoco.rollout``'s threaded API —
-the Podracer split (batched host physics feeding a device-side policy).
+lane-vectorized (one batched device forward per timestep for a whole lane
+block), and for real MuJoCo ``-v5`` envs the lanes are stepped by the batched
+``envs.mujoco.MjVecEnv`` engine over ``mujoco.rollout``'s threaded API.
+The default ``host_pipeline="pipelined"`` drives the lanes with the
+Sebulba-style scheduler (``net.hostvecenv.run_host_pipelined_rollout``):
+the whole batch is submitted at once, the device forward for one lane block
+overlaps the host physics for the other, and finished lanes are immediately
+re-seeded from the batch-wide pending queue — the Podracer split plus
+host-side continuous batching (docs/eval_contracts.md, "The host pipeline").
 """
 
 from __future__ import annotations
@@ -55,6 +60,9 @@ class GymNE(NEProblem):
         seed: Optional[int] = None,
         num_actors=None,
         vector_env_backend: str = "auto",
+        host_pipeline: str = "pipelined",
+        host_pipeline_blocks: Optional[int] = None,
+        mj_nthread: Optional[int] = None,
         **kwargs,
     ):
         if env is None and env_name is None:
@@ -86,6 +94,30 @@ class GymNE(NEProblem):
                 "vector_env_backend must be 'auto', 'mujoco' or 'sync',"
                 f" got {vector_env_backend!r}"
             )
+        # host_pipeline picks the scheduler that drives the lanes:
+        # "pipelined" (default) — the Sebulba-style two-lane-block scheduler
+        # with work-conserving lane refill over the WHOLE batch (device
+        # forward for block A overlaps host physics for block B);
+        # "sync" — the same scheduler, same event order, no worker thread
+        # (bit-identical scores/stats: the determinism baseline);
+        # "chunked" — the legacy serial fixed-chunk loop (one
+        # run_host_vectorized_rollout per num_envs-sized chunk), kept as the
+        # A/B reference the pipeline is benched against.
+        self._host_pipeline = str(host_pipeline)
+        if self._host_pipeline not in ("pipelined", "sync", "chunked"):
+            raise ValueError(
+                "host_pipeline must be 'pipelined', 'sync' or 'chunked',"
+                f" got {host_pipeline!r}"
+            )
+        # None = the scheduler's host-adaptive block split (2 when the box
+        # has a second core to overlap on, else 1). NOTE: with observation
+        # normalization on, the block count sets the obs-stat accumulation
+        # grouping, so auto makes scores bitwise host-dependent — pass an
+        # explicit count for cross-machine bit-reproducibility.
+        self._host_pipeline_blocks = (
+            None if host_pipeline_blocks is None else int(host_pipeline_blocks)
+        )
+        self._mj_nthread = None if mj_nthread is None else int(mj_nthread)
         self._vec_env = None
 
         self._make_gym_env()  # early, so network constants are available
@@ -224,9 +256,13 @@ class GymNE(NEProblem):
                 from ..envs.mujoco.mjvecenv import MjVecEnv
 
                 if backend == "mujoco":
-                    self._vec_env = MjVecEnv(self._build_one_env, self._num_envs)
+                    self._vec_env = MjVecEnv(
+                        self._build_one_env, self._num_envs, nthread=self._mj_nthread
+                    )
                 else:
-                    self._vec_env = make_host_vector_env(self._build_one_env, self._num_envs)
+                    self._vec_env = make_host_vector_env(
+                        self._build_one_env, self._num_envs, nthread=self._mj_nthread
+                    )
                 return self._vec_env
             except ImportError:
                 if backend == "mujoco":
@@ -239,28 +275,66 @@ class GymNE(NEProblem):
     def _evaluate_batch(self, batch):
         if self._num_envs is None or self._num_envs <= 1:
             return super()._evaluate_batch(batch)
-        from .net.hostvecenv import run_host_vectorized_rollout
-
         vec_env = self._make_vector_env()
         values = jnp.asarray(batch.values)
-        n = values.shape[0]
-        scores = []
-        for start in range(0, n, self._num_envs):
-            result = run_host_vectorized_rollout(
+        obs_stats = self._obs_stats if self._observation_normalization else None
+        common = dict(
+            num_episodes=self._num_episodes,
+            episode_length=self._episode_length,
+            obs_stats=obs_stats,
+            decrease_rewards_by=self._decrease_rewards_by,
+            alive_bonus_schedule=self._alive_bonus_schedule,
+            action_noise_stdev=self._action_noise_stdev,
+        )
+        if self._host_pipeline == "chunked":
+            # legacy PR-2 path: serial fixed-size chunks, each padded to its
+            # slowest episode — the A/B baseline for the pipelined scheduler
+            from .net.hostvecenv import run_host_vectorized_rollout
+
+            n = values.shape[0]
+            scores = []
+            for start in range(0, n, self._num_envs):
+                result = run_host_vectorized_rollout(
+                    vec_env, self._policy, values[start : start + self._num_envs], **common
+                )
+                scores.append(result["scores"])
+                self._interaction_count += result["interactions"]
+                self._episode_count += result["episodes"]
+            batch.set_evals(jnp.asarray(np.concatenate(scores), dtype=jnp.float32))
+            return
+        # whole-batch submission: every (solution, episode) item goes into one
+        # pending queue and freed lanes are re-seeded immediately, so a long
+        # episode stalls one lane, not a whole chunk
+        from .net.hostvecenv import HungPhysicsWorkerError, run_host_pipelined_rollout
+
+        try:
+            result = run_host_pipelined_rollout(
                 vec_env,
                 self._policy,
-                values[start : start + self._num_envs],
-                num_episodes=self._num_episodes,
-                episode_length=self._episode_length,
-                obs_stats=self._obs_stats if self._observation_normalization else None,
-                decrease_rewards_by=self._decrease_rewards_by,
-                alive_bonus_schedule=self._alive_bonus_schedule,
-                action_noise_stdev=self._action_noise_stdev,
+                values,
+                mode=self._host_pipeline,
+                num_blocks=self._host_pipeline_blocks,
+                **common,
             )
-            scores.append(result["scores"])
-            self._interaction_count += result["interactions"]
-            self._episode_count += result["episodes"]
-        batch.set_evals(jnp.asarray(np.concatenate(scores), dtype=jnp.float32))
+        except HungPhysicsWorkerError:
+            # the physics worker thread is still alive inside this vec_env (a
+            # hung native step): closing under a running thread could crash,
+            # so just drop the reference and never reuse it
+            self._vec_env = None
+            raise
+        except BaseException:
+            # a failed evaluation leaves env lanes mid-episode: close the
+            # vec_env (its worker exited cleanly) and build a fresh one next
+            # time rather than leaking gym envs / native MuJoCo buffers
+            self._vec_env = None
+            try:
+                vec_env.close()
+            except Exception:
+                pass
+            raise
+        self._interaction_count += result["interactions"]
+        self._episode_count += result["episodes"]
+        batch.set_evals(jnp.asarray(result["scores"], dtype=jnp.float32))
 
     def run_solution(self, solution, *, num_episodes: int = 1, visualize: bool = False) -> float:
         """Deterministically run a solution (no stat updates)."""

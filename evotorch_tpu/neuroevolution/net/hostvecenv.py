@@ -1,4 +1,4 @@
-"""Host-side synchronous vector env + batched rollout for gym-API envs.
+"""Host-side vector envs + batched/pipelined rollouts for gym-API envs.
 
 Parity: reference ``net/vecrl.py:1541-1912`` (``SyncVectorEnv``) and the
 vectorized evaluation loop of ``vecgymne.py:744-916`` as applied to
@@ -8,6 +8,28 @@ a *batched* policy forward — one device call per timestep for the whole lane
 block, instead of one per env (the reference's torch-policy-over-numpy-envs
 pattern, jax-side here).
 
+Two rollout engines share the vector-env contract:
+
+- :func:`run_host_vectorized_rollout` — the original synchronous loop: one
+  lane block, device forward and host physics strictly alternating, each
+  solution pinned to one lane for all its episodes. Deliberately kept
+  **byte-stable as the PR-2 reference implementation**: the pipelined
+  engine's regression tests compare against it bit-exactly, and it is the
+  "synchronous host path" baseline `bench.py`'s `mj_pipeline_speedup`
+  measures against (`GymNE(host_pipeline="chunked")` routes here).
+- :func:`run_host_pipelined_rollout` — the Sebulba-style scheduler
+  (Podracer, arXiv:2104.06272): the lanes are split into blocks; while the
+  device runs the batched policy forward for block A, a host worker thread
+  runs the physics for block B, with the ``np.asarray`` device sync confined
+  to the swap point. On top of the overlap it is **work-conserving**: the
+  whole batch's (solution, episode) items form one pending queue, and a lane
+  whose episode finishes is immediately re-seeded with the next pending item
+  — the host-side mirror of the on-device ``episodes_refill`` contract
+  (``vecrl.py``), so a single long episode no longer stalls its block. Its
+  ``mode="sync"`` fallback executes the *identical* event order without the
+  worker thread, which makes pipelined-vs-sync bit-identity a testable
+  invariant (see ``docs/eval_contracts.md``, "The host pipeline").
+
 This is the capability class for environments that only exist as Python/gym
 code. The TPU-native throughput path remains ``VecNE`` over pure-JAX envs
 (``vecrl.run_vectorized_rollout``).
@@ -15,6 +37,10 @@ code. The TPU-native throughput path remains ``VecNE`` over pure-JAX envs
 
 from __future__ import annotations
 
+import os
+import queue
+import threading
+from collections import deque
 from functools import partial
 from typing import Callable, List, Optional, Sequence, Union
 
@@ -25,7 +51,18 @@ import numpy as np
 from .rl import alive_bonus_for_step_host
 from .vecrl import reset_tensors
 
-__all__ = ["SyncVectorEnv", "run_host_vectorized_rollout"]
+__all__ = [
+    "SyncVectorEnv",
+    "run_host_vectorized_rollout",
+    "run_host_pipelined_rollout",
+    "HungPhysicsWorkerError",
+]
+
+
+class HungPhysicsWorkerError(RuntimeError):
+    """The pipeline's physics worker thread would not exit (a hung native
+    step). The vector env it was driving must be discarded, NOT closed or
+    reused — its buffers may still be touched by the stuck thread."""
 
 
 # module-level jitted forwards with the policy as a static arg: the jit cache
@@ -261,4 +298,370 @@ def run_host_vectorized_rollout(
         "scores": scores / np.maximum(episodes_done, 1),
         "interactions": interactions,
         "episodes": int(episodes_done.sum()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the Sebulba-style pipelined scheduler (host refill + host/device overlap)
+# ---------------------------------------------------------------------------
+
+# gathered forwards: the full (P, L) parameter matrix lives on device once per
+# evaluation; each block's forward gathers its lanes' CURRENT solutions by
+# index inside the jitted program, so a refill changes one integer per lane
+# instead of shipping a fresh (w, L) parameter block over the host link every
+# timestep. sol_idx is a traced argument — refills never retrace.
+@partial(jax.jit, static_argnames=("policy",))
+def _forward_gather_stateless(policy, params_all, sol_idx, obs):
+    return jax.vmap(lambda p, o: policy(p, o))(params_all[sol_idx], obs)
+
+
+@partial(jax.jit, static_argnames=("policy",))
+def _forward_gather_stateful(policy, params_all, sol_idx, obs, states):
+    return jax.vmap(policy)(params_all[sol_idx], obs, states)
+
+
+class _PhysicsWorker:
+    """One host thread draining a FIFO of ``vec_env.step`` calls.
+
+    The double buffer of the pipeline: the main thread submits block A's
+    actions and immediately goes on to materialize block B's forward (the
+    only ``block_until_ready``-equivalent sync point) while the physics for
+    A runs here. ``mujoco.rollout`` releases the GIL, so on a multi-core
+    host the physics genuinely overlaps the device forward *and* the main
+    thread's numpy bookkeeping. Results come back in submission order —
+    exactly the order the scheduler retires blocks — so a single result
+    queue is the whole synchronization story.
+    """
+
+    def __init__(self, vec_env):
+        self._vec_env = vec_env
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._results: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name="hostvecenv-physics", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            task = self._tasks.get()
+            if task is None:
+                return
+            actions, active = task
+            try:
+                self._results.put(("ok", self._vec_env.step(actions, active=active)))
+            except BaseException as exc:  # surfaced on the main thread
+                self._results.put(("error", exc))
+
+    def submit(self, actions, active):
+        self._tasks.put((actions, active))
+
+    def result(self):
+        status, payload = self._results.get()
+        if status == "error":
+            raise payload
+        return payload
+
+    def close(self):
+        """Stop the thread; raises if it will not die (a hung native physics
+        call) — the caller must then discard the vec_env rather than hand it
+        to a fresh worker, or two threads would race on the same MjData
+        buffers."""
+        self._tasks.put(None)
+        # generous: at most ONE physics step is in flight ahead of the
+        # sentinel, and a block step is milliseconds — only a hung native
+        # call exceeds this
+        self._thread.join(timeout=60)
+        if self._thread.is_alive():
+            raise HungPhysicsWorkerError(
+                "hostvecenv physics worker did not exit (native step hung);"
+                " discard this vector env — it is not safe to reuse"
+            )
+
+
+class _LaneBlock:
+    """One lane block of the pipeline: a contiguous slice of env lanes, the
+    (solution, episode) item each lane is currently serving, and the block's
+    in-flight forward."""
+
+    __slots__ = (
+        "lanes", "sl", "item", "active", "obs", "states", "fwd", "pending_states",
+        "iters", "sol_idx_dev", "full_actions", "full_active",
+    )
+
+    def __init__(self, lanes: np.ndarray, items: np.ndarray, obs: np.ndarray, states, num_envs: int, act_shape, act_dtype):
+        self.lanes = lanes  # global lane indices, (w,) — contiguous
+        self.sl = slice(int(lanes[0]), int(lanes[-1]) + 1)  # view, not copy
+        self.item = items  # global item id per lane, -1 = exhausted, (w,)
+        self.active = items >= 0
+        self.obs = obs  # (w, obs_dim) float32
+        self.states = states  # per-lane policy state pytree or None
+        self.fwd = None  # dispatched forward (out, new_states) or None
+        self.pending_states = None
+        self.iters = 0  # lockstep iterations this block executed
+        self.sol_idx_dev = None  # cached lane->solution index vector
+        # reusable full-width submission buffers (refreshed in place)
+        self.full_actions = np.zeros((num_envs,) + act_shape, dtype=act_dtype)
+        self.full_active = np.zeros(num_envs, dtype=bool)
+        self.full_active[lanes] = self.active
+
+
+def run_host_pipelined_rollout(
+    vec_env,
+    policy,
+    params_batch,
+    *,
+    num_episodes: int = 1,
+    episode_length: Optional[int] = None,
+    obs_stats=None,
+    update_stats: bool = True,
+    decrease_rewards_by: float = 0.0,
+    alive_bonus_schedule: Optional[tuple] = None,
+    action_noise_stdev: Optional[float] = None,
+    rng: Optional[np.random.Generator] = None,
+    mode: str = "pipelined",
+    num_blocks: Optional[int] = None,
+) -> dict:
+    """Evaluate a whole batch of ``P`` policies over ``vec_env``'s lanes with
+    the pipelined two-lane-block scheduler.
+
+    The work list is every (solution, episode) pair — ``P * num_episodes``
+    items, solution-major. ``W = min(items, num_envs)`` lanes are split into
+    ``num_blocks`` contiguous blocks; each scheduler round runs, per block:
+
+    - **S1** normalize the block's observations and *dispatch* the batched
+      device forward (async);
+    - **S2** materialize the actions (``np.asarray`` — the swap point, the
+      only device sync) and submit the block's physics;
+    - **S3** collect the physics results, do all bookkeeping (reward credit,
+      episode accounting, obs-stat updates) and **refill** each finished lane
+      with the next pending item, so lanes never idle while work remains.
+
+    ``mode="pipelined"`` runs the physics on a worker thread with a
+    one-submission pipeline depth: block A's physics overlaps block B's
+    device forward (the Sebulba split). ``mode="sync"`` executes the physics
+    inline at the submit point — the **same S1/S2/S3 event order**, so
+    scores, per-episode step counts, RNG draws and obs-normalization
+    statistics are bit-identical between the two modes; the thread is the
+    only difference. All bookkeeping lives on the main thread, which is what
+    makes that determinism structural rather than lucky.
+
+    Returns ``{"scores" (P,), "interactions", "episodes",
+    "episode_steps" (P, num_episodes), "lane_episodes" (num_envs,),
+    "block_iters" [per-block lockstep iteration counts]}``.
+    """
+    if mode not in ("pipelined", "sync"):
+        raise ValueError(f"mode must be 'pipelined' or 'sync', got {mode!r}")
+    params_batch = jnp.asarray(params_batch)
+    num_solutions = int(params_batch.shape[0])
+    episodes_per_solution = int(num_episodes)
+    total_items = num_solutions * episodes_per_solution
+    if total_items == 0:
+        return {
+            "scores": np.zeros(num_solutions, dtype=np.float64),
+            "interactions": 0,
+            "episodes": 0,
+            "episode_steps": np.zeros((num_solutions, episodes_per_solution), dtype=np.int64),
+            "lane_episodes": np.zeros(vec_env.num_envs, dtype=np.int64),
+            "block_iters": [],
+        }
+    rng = np.random.default_rng() if rng is None else rng
+
+    width = min(total_items, vec_env.num_envs)
+    if num_blocks is None:
+        # auto: the two-block split only pays when the host physics can
+        # genuinely overlap the device forward — on a single-core box the
+        # split just doubles the per-round dispatch cost, so run one block
+        # and keep the refill win
+        num_blocks = 2 if (os.cpu_count() or 1) > 1 else 1
+    num_blocks = max(1, min(int(num_blocks), width))
+    act_space = vec_env.action_space
+    discrete = vec_env.is_discrete
+    act_shape = () if discrete else tuple(act_space.shape)
+
+    # hard cap (ADVICE r2, same contract as the synchronous loop): an env
+    # with neither its own TimeLimit nor episode_length= must fail loudly
+    per_episode_cap = int(episode_length) if episode_length is not None else 100_000
+
+    # ---- global accounting --------------------------------------------------
+    item_return = np.zeros(total_items, dtype=np.float64)
+    item_steps = np.zeros(total_items, dtype=np.int64)
+    lane_episodes = np.zeros(vec_env.num_envs, dtype=np.int64)
+    steps_in_episode = np.zeros(vec_env.num_envs, dtype=np.int64)
+    interactions = 0
+    episodes_finished = 0
+    next_item = width  # items 0..width-1 seed the lanes below
+
+    # ---- lanes + blocks -----------------------------------------------------
+    all_obs = vec_env.reset()[:width]
+    proto = policy.initial_state()
+    blocks: List[_LaneBlock] = []
+    for lanes in np.array_split(np.arange(width), num_blocks):
+        lanes = lanes.astype(np.int64)
+        if proto is None:
+            states = None
+        else:
+            states = jax.tree_util.tree_map(
+                lambda leaf: jnp.broadcast_to(leaf, (len(lanes),) + leaf.shape), proto
+            )
+        blocks.append(
+            _LaneBlock(
+                lanes, lanes.copy(), all_obs[lanes], states, vec_env.num_envs,
+                act_shape, np.int64 if discrete else np.float64,
+            )
+        )
+        lane_episodes[lanes] += 1
+    if obs_stats is not None and update_stats:
+        for blk in blocks:  # block order: the canonical accumulation order
+            obs_stats.update(blk.obs[blk.active])
+
+    # ---- stages -------------------------------------------------------------
+    def s1_dispatch_forward(blk: _LaneBlock):
+        norm_obs = blk.obs
+        if obs_stats is not None and obs_stats.count >= 2:
+            norm_obs = np.asarray(obs_stats.normalize(norm_obs), dtype=np.float32)
+        # unconditional, matching the reference loop: scrubs both the NaN
+        # dummy rows of exhausted lanes AND non-finite observations from
+        # diverged physics on live lanes (no-termination families)
+        norm_obs = np.nan_to_num(norm_obs)
+        if blk.sol_idx_dev is None:  # refreshed only after a refill/exhaustion
+            blk.sol_idx_dev = np.where(blk.item >= 0, blk.item // episodes_per_solution, 0)
+        # numpy arguments go straight into the jitted call: jit's own arg
+        # transfer is ~3x cheaper than a separate jnp.asarray dispatch here
+        if blk.states is None:
+            blk.fwd = _forward_gather_stateless(
+                policy, params_batch, blk.sol_idx_dev, norm_obs
+            )
+        else:
+            blk.fwd = _forward_gather_stateful(
+                policy, params_batch, blk.sol_idx_dev, norm_obs, blk.states
+            )
+
+    def s2_submit_physics(blk: _LaneBlock, worker: Optional[_PhysicsWorker]):
+        out, new_states = blk.fwd
+        blk.fwd = None
+        blk.pending_states = new_states
+        out = np.asarray(out)  # the swap point: the pipeline's only device sync
+        if discrete:
+            actions = np.argmax(out, axis=-1)
+        else:
+            actions = out.astype(np.float64).reshape((len(blk.lanes),) + act_shape)
+            if action_noise_stdev is not None:
+                actions = actions + rng.normal(size=actions.shape) * float(action_noise_stdev)
+            actions = np.clip(actions, act_space.low, act_space.high)
+        blk.full_actions[blk.sl] = actions
+        if worker is not None:
+            worker.submit(blk.full_actions, blk.full_active)
+            return None
+        return vec_env.step(blk.full_actions, active=blk.full_active)
+
+    def s3_bookkeep_and_refill(blk: _LaneBlock, step_result):
+        nonlocal interactions, episodes_finished, next_item
+        obs_full, rewards_full, dones_full = step_result
+        obs = obs_full[blk.sl]
+        rewards = rewards_full[blk.sl].astype(np.float64)
+        env_dones = dones_full[blk.sl]
+        active = blk.active
+        blk.iters += 1
+
+        block_steps = steps_in_episode[blk.sl]  # view: writes land globally
+        block_steps[active] += 1
+        if np.any(block_steps[active] > 100_000):
+            raise RuntimeError(
+                "run_host_pipelined_rollout exceeded 100000 steps in one"
+                " episode; the env likely never terminates — pass"
+                " episode_length= or wrap it in a TimeLimit"
+            )
+        interactions += int(active.sum())
+        dones = env_dones.copy()
+        if episode_length is not None:
+            dones |= active & (block_steps >= per_episode_cap)
+
+        if decrease_rewards_by != 0.0:
+            rewards = rewards - decrease_rewards_by
+        if alive_bonus_schedule is not None:
+            # host loop, host step counters: pure-python bonus (the jnp form
+            # would dispatch + sync one device scalar per lane per step)
+            for j in np.flatnonzero(active & ~dones):
+                rewards[j] += alive_bonus_for_step_host(
+                    int(steps_in_episode[blk.lanes[j]]), alive_bonus_schedule
+                )
+        # lane items are distinct, so a fancy-indexed add is exact
+        item_return[blk.item[active]] += rewards[active]
+
+        finished = dones & active
+        if finished.any():
+            for j in np.flatnonzero(finished):
+                lane = int(blk.lanes[j])
+                item_steps[blk.item[j]] = steps_in_episode[lane]
+                steps_in_episode[lane] = 0
+                episodes_finished += 1
+                if next_item < total_items:  # work-conserving refill
+                    blk.item[j] = next_item
+                    next_item += 1
+                    lane_episodes[lane] += 1
+                    if not env_dones[j]:
+                        # truncated by episode_length: the env auto-resets
+                        # only on its own terminal signal, so reseed manually
+                        obs[j] = vec_env._reset_one(lane)
+                    # (on env_dones the eager auto-reset obs in `obs[j]` IS
+                    # the refilled item's fresh initial observation)
+                else:
+                    blk.item[j] = -1
+                    blk.active[j] = False
+            blk.sol_idx_dev = None  # lane->solution mapping changed
+            blk.full_active[blk.lanes] = blk.active
+            if blk.pending_states is not None:
+                blk.states = reset_tensors(blk.pending_states, jnp.asarray(finished))
+                blk.pending_states = None
+        if blk.pending_states is not None:
+            blk.states = blk.pending_states
+            blk.pending_states = None
+        blk.obs = obs
+        if obs_stats is not None and update_stats and blk.active.any():
+            obs_stats.update(obs[blk.active])
+
+    # ---- the scheduler loop -------------------------------------------------
+    # Round-robin over blocks in a FIXED order; `inflight` is the FIFO of
+    # blocks whose physics is submitted but not yet retired. In pipelined
+    # mode one submission stays in flight across the S2 of the next block, so
+    # its physics (worker thread) overlaps that block's device forward; in
+    # sync mode the depth is 0 and every submission retires immediately. The
+    # S1/S2/S3 event sequence is identical in both modes — only the waiting
+    # pattern differs — which is the determinism guarantee.
+    worker = _PhysicsWorker(vec_env) if mode == "pipelined" else None
+    depth = 1 if worker is not None else 0
+    live = [blk for blk in blocks if blk.active.any()]
+    inflight: deque = deque()
+    try:
+        for blk in live:
+            s1_dispatch_forward(blk)
+        while live:
+            for blk in blocks:
+                if blk in live and blk.fwd is not None:
+                    result = s2_submit_physics(blk, worker)
+                    inflight.append((blk, result))
+            while inflight and (
+                len(inflight) > depth
+                or not any(b.fwd is not None for b in live)
+            ):
+                prev, result = inflight.popleft()
+                if result is None:
+                    result = worker.result()
+                s3_bookkeep_and_refill(prev, result)
+                if prev.active.any():
+                    s1_dispatch_forward(prev)
+                else:
+                    live.remove(prev)
+    finally:
+        if worker is not None:
+            worker.close()
+
+    return {
+        "scores": item_return.reshape(num_solutions, episodes_per_solution).mean(axis=1),
+        "interactions": interactions,
+        "episodes": episodes_finished,
+        "episode_steps": item_steps.reshape(num_solutions, episodes_per_solution),
+        "lane_episodes": lane_episodes,
+        "block_iters": [blk.iters for blk in blocks],
     }

@@ -14,6 +14,14 @@ unsafe), and the reference's main<->actor sync protocol
 
 Workers force the CPU jax backend: host-side rollouts are numpy/gym work, and
 a worker must never contend for the (single-client) TPU.
+
+Actor-side evaluation composes with the in-process schedulers unchanged: a
+``GymNE(num_envs=k)`` clone inside a worker drives its lanes with the
+pipelined host scheduler (``net.hostvecenv.run_host_pipelined_rollout`` —
+Sebulba overlap + batch-wide lane refill over each worker's piece), and the
+obs-norm delta-sync protocol is untouched — the worker still reports exactly
+the statistics its lanes consumed, whatever order the scheduler collected
+them in (the delta is a sum, so scheduling does not change what merges home).
 """
 
 from __future__ import annotations

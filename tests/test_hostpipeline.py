@@ -1,0 +1,318 @@
+"""The Sebulba-style host evaluation pipeline (``run_host_pipelined_rollout``).
+
+Three invariants, each load-bearing for the real-MuJoCo backend:
+
+- **pipelined == sync, bit-identical**: the worker-thread overlap must not
+  change a single bit of scores, per-episode step counts, or obs-norm
+  statistics — all bookkeeping lives on the main thread in a fixed event
+  order, and these tests are the proof that the order survives the thread.
+- **pipelined == the PR-2 chunked reference** at matched width (one chunk,
+  one episode per solution, no obs-norm): the new scheduler is a superset,
+  not a reinterpretation, of the synchronous path's semantics.
+- **work conservation**: a straggler episode stalls one lane, not its whole
+  block — freed lanes immediately serve the next pending (solution, episode)
+  item, mirroring the on-device ``episodes_refill`` contract.
+
+All fast-tier tests run on the generic ``SyncVectorEnv`` (no mujoco marker);
+the real-MuJoCo pipeline tests live in ``tests/test_mujoco.py``.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from evotorch_tpu.neuroevolution.net import RNN, FlatParamsPolicy, Linear
+from evotorch_tpu.neuroevolution.net.hostvecenv import (
+    SyncVectorEnv,
+    run_host_pipelined_rollout,
+    run_host_vectorized_rollout,
+)
+from evotorch_tpu.neuroevolution.net.runningnorm import RunningStat
+
+
+# ---------------------------------------------------------------------------
+# a deterministic gym-API env with policy-controlled episode length
+# ---------------------------------------------------------------------------
+
+
+class _ProgrammableLengthEnv:
+    """obs = [1.0]; the FIRST action of an episode programs its length:
+    ``L = clip(round(10 * a0), 1, 60)``. Purely deterministic, so straggler
+    scenarios (one long episode among short ones) can be constructed exactly
+    from the policy parameters."""
+
+    class _Box:
+        low = np.asarray([-10.0])
+        high = np.asarray([10.0])
+        shape = (1,)
+
+    observation_space = _Box()
+    action_space = _Box()
+
+    def __init__(self):
+        self._t = 0
+        self._length = 1
+
+    def reset(self, seed=None):
+        self._t = 0
+        self._length = 1
+        return np.asarray([1.0], dtype=np.float32), {}
+
+    def step(self, action):
+        if self._t == 0:
+            self._length = int(np.clip(round(10.0 * float(np.asarray(action).reshape(-1)[0])), 1, 60))
+        self._t += 1
+        done = self._t >= self._length
+        return np.asarray([1.0], dtype=np.float32), 1.0, done, False, {}
+
+    def close(self):
+        pass
+
+
+def _cartpole_vec(n):
+    gym = pytest.importorskip("gymnasium")
+    vec = SyncVectorEnv(lambda: gym.make("CartPole-v1"), n)
+    vec.seed(range(100, 100 + n))
+    return vec
+
+
+def _params(policy, popsize, seed=0, scale=0.5):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.normal(size=(popsize, policy.parameter_count)) * scale, jnp.float32
+    )
+
+
+# ---------------------------------------------------------------------------
+# bit-identical determinism: pipelined vs the sync fallback
+# ---------------------------------------------------------------------------
+
+
+def _run_both_modes(popsize, num_envs, num_episodes, episode_length, *, noise=None):
+    policy = FlatParamsPolicy(Linear(4, 2))
+    params = _params(policy, popsize)
+    out = {}
+    for mode in ("pipelined", "sync"):
+        vec = _cartpole_vec(num_envs)
+        stats = RunningStat()
+        result = run_host_pipelined_rollout(
+            vec,
+            policy,
+            params,
+            num_episodes=num_episodes,
+            episode_length=episode_length,
+            obs_stats=stats,
+            action_noise_stdev=noise,
+            rng=np.random.default_rng(7),
+            mode=mode,
+        )
+        vec.close()
+        out[mode] = (result, stats)
+    return out
+
+
+def _assert_bit_identical(out):
+    r_pipe, s_pipe = out["pipelined"]
+    r_sync, s_sync = out["sync"]
+    # scores, step counts and interaction accounting: exact, not allclose
+    assert np.array_equal(r_pipe["scores"], r_sync["scores"])
+    assert np.array_equal(r_pipe["episode_steps"], r_sync["episode_steps"])
+    assert np.array_equal(r_pipe["lane_episodes"], r_sync["lane_episodes"])
+    assert r_pipe["interactions"] == r_sync["interactions"]
+    assert r_pipe["episodes"] == r_sync["episodes"]
+    # final obs-norm statistics: same count, same sums to the last bit (the
+    # accumulation order is part of the scheduler's contract)
+    assert s_pipe.count == s_sync.count
+    assert np.array_equal(np.asarray(s_pipe.sum), np.asarray(s_sync.sum))
+    assert np.array_equal(
+        np.asarray(s_pipe.sum_of_squares), np.asarray(s_sync.sum_of_squares)
+    )
+
+
+def test_pipelined_matches_sync_bit_identical_tiny():
+    # popsize > lanes exercises refill; obs-norm on; discrete actions
+    _assert_bit_identical(_run_both_modes(6, 4, 2, 30))
+
+
+@pytest.mark.slow
+def test_pipelined_matches_sync_bit_identical_larger_shape():
+    _assert_bit_identical(_run_both_modes(24, 10, 3, 60))
+
+
+def test_pipelined_matches_sync_stateful_policy():
+    # recurrent policy: per-lane state pytrees ride the blocks, get zeroed on
+    # refill (reset_tensors), and must not break bit-identity either
+    policy = FlatParamsPolicy(RNN(1, 4) >> Linear(4, 1))
+    params = _params(policy, 5, seed=2, scale=2.0)
+    out = {}
+    for mode in ("pipelined", "sync"):
+        vec = SyncVectorEnv(_ProgrammableLengthEnv, 3)
+        result = run_host_pipelined_rollout(
+            vec, policy, params, num_episodes=1, episode_length=40, mode=mode
+        )
+        vec.close()
+        out[mode] = result
+    assert np.array_equal(out["pipelined"]["scores"], out["sync"]["scores"])
+    assert np.array_equal(
+        out["pipelined"]["episode_steps"], out["sync"]["episode_steps"]
+    )
+    assert (out["pipelined"]["episode_steps"] > 0).all()
+
+
+def test_pipelined_matches_sync_with_action_noise():
+    # the continuous-action path: noise draws come from the caller's rng in
+    # the scheduler's fixed S2 order, so they too must be bit-identical
+    policy = FlatParamsPolicy(Linear(1, 1))
+    params = _params(policy, 5, scale=0.2)
+    out = {}
+    for mode in ("pipelined", "sync"):
+        vec = SyncVectorEnv(_ProgrammableLengthEnv, 3)
+        result = run_host_pipelined_rollout(
+            vec,
+            policy,
+            params,
+            num_episodes=1,
+            episode_length=50,
+            action_noise_stdev=0.05,
+            rng=np.random.default_rng(3),
+            mode=mode,
+        )
+        vec.close()
+        out[mode] = (result, None)
+    assert np.array_equal(out["pipelined"][0]["scores"], out["sync"][0]["scores"])
+    assert np.array_equal(
+        out["pipelined"][0]["episode_steps"], out["sync"][0]["episode_steps"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# equivalence with the PR-2 synchronous reference path
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_matches_chunked_reference_at_matched_width():
+    # one chunk (popsize == num_envs), one episode, no obs-norm: each lane's
+    # trajectory is independent of scheduling, so the pipelined scheduler must
+    # reproduce the synchronous loop's scores exactly
+    policy = FlatParamsPolicy(Linear(4, 2))
+    params = _params(policy, 4)
+
+    vec = _cartpole_vec(4)
+    reference = run_host_vectorized_rollout(
+        vec, policy, params, num_episodes=1, episode_length=40
+    )
+    vec.close()
+
+    vec = _cartpole_vec(4)
+    pipelined = run_host_pipelined_rollout(
+        vec, policy, params, num_episodes=1, episode_length=40, mode="pipelined"
+    )
+    vec.close()
+
+    assert np.array_equal(reference["scores"], pipelined["scores"])
+    assert reference["interactions"] == pipelined["interactions"]
+    assert reference["episodes"] == pipelined["episodes"]
+
+
+# ---------------------------------------------------------------------------
+# work conservation: the straggler no longer serializes its block
+# ---------------------------------------------------------------------------
+
+
+def test_refill_straggler_accounting():
+    # 8 items on 4 lanes; solution 0 programs a 50-step episode, the other 7
+    # program 3-step episodes. The chunked reference pays
+    # max(chunk1) + max(chunk2) lockstep iterations; the refill scheduler
+    # stalls only the straggler's lane while the freed lanes drain the queue.
+    policy = FlatParamsPolicy(Linear(1, 1))
+    # Linear(1,1) on obs=[1.0]: action = w + b; pick (w, b) directly
+    params = np.full((8, policy.parameter_count), 0.15, dtype=np.float32)
+    params[:, 1] = 0.15  # a = 0.3 -> length 3
+    params[0, :] = 2.5  # a = 5.0 -> length 50 (the straggler)
+    params = jnp.asarray(params)
+
+    vec = SyncVectorEnv(_ProgrammableLengthEnv, 4)
+    result = run_host_pipelined_rollout(
+        vec, policy, params, num_episodes=1, episode_length=60, mode="pipelined"
+    )
+    vec.close()
+
+    lengths = result["episode_steps"][:, 0]
+    assert lengths[0] == 50 and (lengths[1:] == 3).all()
+    # the chunked path's cost: each chunk padded to its slowest episode
+    serialized = sum(
+        int(lengths[start : start + 4].max()) for start in range(0, 8, 4)
+    )
+    assert serialized == 53
+    # work conservation: no block ran anywhere near the serialized schedule,
+    # and the straggler's lane kept the others from idling (they served the
+    # whole rest of the queue)
+    assert max(result["block_iters"]) == 50  # the straggler's own length
+    assert max(result["block_iters"]) < serialized
+    assert result["lane_episodes"].sum() == 8
+    assert result["lane_episodes"].max() >= 3  # a freed lane served >= 3 items
+    assert result["interactions"] == int(lengths.sum())
+
+
+def test_pipelined_single_lane_and_empty_batch_edges():
+    policy = FlatParamsPolicy(Linear(1, 1))
+    params = jnp.asarray(np.full((3, policy.parameter_count), 0.15, dtype=np.float32))
+    # one lane: the pipeline degenerates to the sync schedule but must still
+    # drain all items through refill
+    vec = SyncVectorEnv(_ProgrammableLengthEnv, 1)
+    result = run_host_pipelined_rollout(
+        vec, policy, params, num_episodes=2, episode_length=10, mode="pipelined"
+    )
+    vec.close()
+    assert result["episodes"] == 6
+    assert result["lane_episodes"][0] == 6
+    assert (result["episode_steps"] > 0).all()
+    # empty batch
+    vec = SyncVectorEnv(_ProgrammableLengthEnv, 1)
+    empty = run_host_pipelined_rollout(
+        vec, policy, jnp.zeros((0, policy.parameter_count)), mode="sync"
+    )
+    vec.close()
+    assert empty["episodes"] == 0 and empty["scores"].shape == (0,)
+
+
+def test_pipelined_rejects_unknown_mode():
+    policy = FlatParamsPolicy(Linear(1, 1))
+    vec = SyncVectorEnv(_ProgrammableLengthEnv, 1)
+    with pytest.raises(ValueError, match="mode"):
+        run_host_pipelined_rollout(
+            vec, policy, jnp.zeros((1, policy.parameter_count)), mode="async"
+        )
+    vec.close()
+
+
+# ---------------------------------------------------------------------------
+# GymNE integration: whole-batch submission + the host_pipeline knob
+# ---------------------------------------------------------------------------
+
+
+def test_gymne_host_pipeline_knob_and_counters():
+    pytest.importorskip("gymnasium")
+    from evotorch_tpu.neuroevolution import GymNE
+
+    with pytest.raises(ValueError, match="host_pipeline"):
+        GymNE("CartPole-v1", "Linear(obs_length, act_length)", host_pipeline="turbo")
+
+    for hp in ("pipelined", "sync", "chunked"):
+        p = GymNE(
+            "CartPole-v1",
+            "Linear(obs_length, act_length)",
+            num_envs=3,
+            episode_length=25,
+            observation_normalization=True,
+            seed=0,
+            host_pipeline=hp,
+        )
+        batch = p.generate_batch(5)  # > num_envs: refill (or a short chunk)
+        p.evaluate(batch)
+        scores = np.asarray(batch.evals[:, 0])
+        assert scores.shape == (5,)
+        assert (scores >= 1.0).all() and (scores <= 25.0).all()
+        assert int(p.status["total_episode_count"]) == 5
+        assert p.get_observation_stats().count > 0

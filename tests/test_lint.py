@@ -451,6 +451,99 @@ def test_dtype_flags_enable_x64():
 
 
 # ---------------------------------------------------------------------------
+# scoped allow-comments
+# ---------------------------------------------------------------------------
+
+
+def test_scoped_allow_suppresses_named_checker_only():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            # graftlint: allow(host-sync): swap-point sync is this test's point
+            a = np.asarray(x).sum()
+            b = float(x)  # not covered by the allow above
+            return a + b
+        """
+    findings = _lint(src, checkers=["host-sync"])
+    assert [f.detail for f in findings] == ["float-in-trace"]
+
+
+def test_scoped_allow_trailing_same_line():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)  # graftlint: allow(host-sync): intentional demo
+        """
+    assert _lint(src, checkers=["host-sync"]) == []
+
+
+def test_scoped_allow_requires_reason():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)  # graftlint: allow(host-sync)
+        """
+    findings = _lint(src, checkers=["host-sync"])
+    details = sorted(f.detail for f in findings)
+    # the reasonless allow does NOT suppress, and is itself a finding
+    assert details == ["float-in-trace", "missing-reason"]
+
+
+def test_scoped_allow_trailing_does_not_cover_next_line():
+    # a trailing allow excuses its own line ONLY: the adjacent violation
+    # below it must still be reported
+    src = """
+        import jax
+
+        @jax.jit
+        def f(a, b):
+            x = float(a)  # graftlint: allow(host-sync): intentional demo
+            y = float(b)
+            return x + y
+        """
+    findings = _lint(src, checkers=["host-sync"])
+    assert [f.detail for f in findings] == ["float-in-trace"]
+    assert findings[0].line == 7  # the uncovered second float()
+
+
+def test_scoped_allow_inside_string_literal_is_inert():
+    # allow-syntax in a string is data, not a directive: it must neither
+    # suppress findings nor be reported as a reasonless allow
+    src = """
+        import jax
+
+        HELP = "# graftlint: allow(host-sync)"
+
+        @jax.jit
+        def f(x):
+            doc = "# graftlint: allow(host-sync): not a comment"
+            return float(x), doc
+        """
+    findings = _lint(src, checkers=["host-sync"])
+    assert [f.detail for f in findings] == ["float-in-trace"]
+
+
+def test_scoped_allow_multiple_checkers():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            # graftlint: allow(host-sync, dtype): x64 host pull is deliberate here
+            return np.asarray(x) * np.float64(2.0)
+        """
+    assert _lint(src, checkers=["host-sync", "dtype"]) == []
+
+
+# ---------------------------------------------------------------------------
 # the repo gate
 # ---------------------------------------------------------------------------
 

@@ -227,6 +227,165 @@ def test_fidelity_harness_smoke_invertedpendulum():
     json.dumps(report)  # the report must be JSON-serializable as checked in
 
 
+def _hopper_policy_and_params(popsize, *, scale=3.0, straggler_zero=True):
+    import gymnasium as gym
+
+    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear
+
+    env = gym.make("Hopper-v5")
+    obs_dim = env.observation_space.shape[0]
+    act_dim = env.action_space.shape[0]
+    env.close()
+    policy = FlatParamsPolicy(Linear(obs_dim, act_dim))
+    rng = np.random.default_rng(0)
+    params = np.asarray(
+        rng.normal(size=(popsize, policy.parameter_count)) * scale, np.float32
+    )
+    if straggler_zero:
+        # the zero policy survives far longer than aggressive random ones —
+        # a deterministic straggler among fast-dying episodes
+        params[0, :] = 0.0
+    return policy, params
+
+
+def _seeded_hopper_vec(n):
+    import gymnasium as gym
+
+    from evotorch_tpu.envs.mujoco.mjvecenv import MjVecEnv
+
+    vec = MjVecEnv(lambda: gym.make("Hopper-v5"), n)
+    vec.seed(range(200, 200 + n))
+    return vec
+
+
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_hopper_pipelined_matches_sync_bit_identical():
+    """The host pipeline on real physics: the worker-thread overlap must not
+    change a bit of the scores, step counts or obs-norm statistics relative
+    to the sync fallback (identical event order by construction)."""
+    import jax.numpy as jnp
+
+    from evotorch_tpu.neuroevolution.net.hostvecenv import run_host_pipelined_rollout
+    from evotorch_tpu.neuroevolution.net.runningnorm import RunningStat
+
+    policy, params = _hopper_policy_and_params(8)
+    out = {}
+    for mode in ("pipelined", "sync"):
+        vec = _seeded_hopper_vec(4)
+        stats = RunningStat()
+        result = run_host_pipelined_rollout(
+            vec,
+            policy,
+            jnp.asarray(params),
+            num_episodes=1,
+            episode_length=100,
+            obs_stats=stats,
+            mode=mode,
+        )
+        vec.close()
+        out[mode] = (result, stats)
+    r_pipe, s_pipe = out["pipelined"]
+    r_sync, s_sync = out["sync"]
+    assert np.array_equal(r_pipe["scores"], r_sync["scores"])
+    assert np.array_equal(r_pipe["episode_steps"], r_sync["episode_steps"])
+    assert r_pipe["interactions"] == r_sync["interactions"]
+    assert s_pipe.count == s_sync.count
+    assert np.array_equal(np.asarray(s_pipe.sum), np.asarray(s_sync.sum))
+    assert np.array_equal(
+        np.asarray(s_pipe.sum_of_squares), np.asarray(s_sync.sum_of_squares)
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_hopper_pipelined_matches_chunked_reference():
+    """At matched width (one chunk, one episode, no obs-norm) the pipelined
+    scheduler reproduces the PR-2 synchronous path's Hopper scores exactly:
+    per-lane trajectories are scheduling-independent, so any difference would
+    be a scheduler bug."""
+    import jax.numpy as jnp
+
+    from evotorch_tpu.neuroevolution.net.hostvecenv import (
+        run_host_pipelined_rollout,
+        run_host_vectorized_rollout,
+    )
+
+    policy, params = _hopper_policy_and_params(4, straggler_zero=False)
+    vec = _seeded_hopper_vec(4)
+    reference = run_host_vectorized_rollout(
+        vec, policy, jnp.asarray(params), num_episodes=1, episode_length=100
+    )
+    vec.close()
+    vec = _seeded_hopper_vec(4)
+    pipelined = run_host_pipelined_rollout(
+        vec, policy, jnp.asarray(params), num_episodes=1, episode_length=100, mode="pipelined"
+    )
+    vec.close()
+    assert np.array_equal(reference["scores"], pipelined["scores"])
+    assert reference["interactions"] == pipelined["interactions"]
+
+
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_hopper_refill_straggler_no_longer_serializes_the_block():
+    """Work conservation on real physics: one long-lived episode among
+    fast-dying ones. The chunked path pays sum-over-chunks-of-max lockstep
+    iterations; the refill scheduler stalls only the straggler's lane while
+    freed lanes drain the rest of the queue."""
+    import jax.numpy as jnp
+
+    from evotorch_tpu.neuroevolution.net.hostvecenv import run_host_pipelined_rollout
+
+    policy, params = _hopper_policy_and_params(8)
+    vec = _seeded_hopper_vec(4)
+    result = run_host_pipelined_rollout(
+        vec, policy, jnp.asarray(params), num_episodes=1, episode_length=100, mode="pipelined"
+    )
+    vec.close()
+    lengths = result["episode_steps"][:, 0]
+    assert (lengths > 0).all()
+    # the zero-policy straggler outlives the aggressive random policies
+    assert lengths[0] > np.median(lengths[1:])
+    # what the serial fixed-chunk loop would have paid: each num_envs-sized
+    # chunk padded to its slowest episode
+    serialized = sum(int(lengths[s : s + 4].max()) for s in range(0, 8, 4))
+    assert max(result["block_iters"]) < serialized
+    # refilled-lane accounting: freed lanes served multiple items from the
+    # whole-batch queue (that is what kept the block from serializing)
+    assert result["lane_episodes"].sum() == 8
+    assert result["lane_episodes"].max() >= 2
+    assert result["interactions"] == int(lengths.sum())
+
+
+@pytest.mark.slow
+@pytest.mark.mujoco
+def test_mjvecenv_nthread_knob(monkeypatch):
+    import gymnasium as gym
+
+    from evotorch_tpu.envs.mujoco.mjvecenv import MjVecEnv
+    from evotorch_tpu.neuroevolution import GymNE
+
+    # env-var knob
+    monkeypatch.setenv("EVOTORCH_MJ_NTHREAD", "2")
+    vec = MjVecEnv(lambda: gym.make("InvertedPendulum-v5"), 3)
+    assert vec.nthread == 2
+    vec.close()
+    # explicit argument wins over the env var
+    vec = MjVecEnv(lambda: gym.make("InvertedPendulum-v5"), 3, nthread=1)
+    assert vec.nthread == 1
+    vec.close()
+    # GymNE constructor passthrough
+    p = GymNE(
+        "InvertedPendulum-v5",
+        "Linear(obs_length, act_length)",
+        num_envs=2,
+        episode_length=10,
+        mj_nthread=1,
+    )
+    assert p._make_vector_env().nthread == 1
+
+
 def test_native_reward_terms_sum_to_batch_step_reward():
     """Fast tier, pure JAX: the per-term decomposition added for the
     fidelity harness must exactly re-compose each env's step reward."""
