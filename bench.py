@@ -20,6 +20,14 @@ picks which one is the line's primary ``value``; ``compaction_speedup`` and
 
 ``vs_baseline`` = env_steps_per_sec / 1_000_000 (the north-star target).
 
+The line also carries the zero-sync eval telemetry (docs/observability.md):
+``occupancy`` (counted interactions / executed lane-step slots, primary
+contract; per-mode values inside ``modes``), ``refill_events`` (items the
+refill scheduler recycled lanes for) and ``steady_compiles`` (retrace
+sentinel count over every timed loop — anything but 0 is a retrace bug).
+``BENCH_TELEMETRY=0`` compiles the accumulator-free programs (the overhead
+A/B baseline).
+
 ``BENCH_BACKEND=mujoco`` additionally measures the REAL-MuJoCo host path
 (``MjVecEnv`` over ``mujoco.rollout``): the PR-2 synchronous fixed-chunk loop
 vs the Sebulba-style pipelined refill scheduler, reported as
@@ -60,12 +68,14 @@ def main():
         pgpe_tell,
         pgpe_tell_lowrank,
     )
+    from evotorch_tpu.analysis import track_compiles
     from evotorch_tpu.envs import make_env
     from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
     from evotorch_tpu.neuroevolution.net.vecrl import (
         run_vectorized_rollout,
         run_vectorized_rollout_compacting,
     )
+    from evotorch_tpu.observability import EvalTelemetry
 
     cfg = bench_config(use_cpu)
     popsize = cfg["popsize"]
@@ -93,16 +103,21 @@ def main():
         num_episodes=1,
         episode_length=episode_length,
         compute_dtype=compute_dtype,
+        telemetry=cfg["telemetry"],
     )
 
     def measure_mode(mode, key):
         """Run warmup + ``generations`` timed generations of one contract;
-        returns (steps_per_sec, generations_per_sec, key). Each mode gets a
-        fresh optimizer state: the jitted generation DONATES it
-        (``donate_argnums``), so the ask-tell hot loop reuses the state and
-        population buffers in place instead of allocating per generation —
-        sharing one state object across modes would hand a donated
-        (invalidated) buffer to the next mode's first call."""
+        returns (steps_per_sec, generations_per_sec, key, telemetry,
+        steady_compiles). Each mode gets a fresh optimizer state: the jitted
+        generation DONATES it (``donate_argnums``), so the ask-tell hot loop
+        reuses the state and population buffers in place instead of
+        allocating per generation — sharing one state object across modes
+        would hand a donated (invalidated) buffer to the next mode's first
+        call. The telemetry vector rides out of the same jitted program as
+        the scores (zero extra dispatches) and is decoded once, after the
+        clock stops; the timed loop runs under the retrace sentinel, so a
+        steady-state recompile shows up as a nonzero ``steady_compiles``."""
         state = fresh_pgpe_state(policy.parameter_count)
         if mode == "episodes_compact":
             ask_jit = jax.jit(partial(ask, popsize=popsize))
@@ -119,10 +134,10 @@ def main():
                     **ckw, **rollout_kwargs,
                 )
                 state = tell_jit(state, values, result.scores)
-                return state, result.total_steps, result.scores
+                return state, result.total_steps, result.scores, result.telemetry
 
             key, sub = jax.random.split(key)
-            state, steps, scores = gen(state, sub, prewarm=True)
+            state, steps, scores, telemetry = gen(state, sub, prewarm=True)
             jax.block_until_ready(scores)
         else:
             extra = refill_kwargs(cfg) if mode == "episodes_refill" else {}
@@ -135,30 +150,46 @@ def main():
                     **extra, **rollout_kwargs,
                 )
                 state = tell(state, values, result.scores)
-                return state, result.total_steps, result.scores
+                return state, result.total_steps, result.scores, result.telemetry
 
             # donate the optimizer state: ask/tell and the rollout carry run
             # allocation-free generation to generation
             gen = jax.jit(generation, donate_argnums=(0,))
             key, sub = jax.random.split(key)
-            state, steps, scores = gen(state, sub)
+            state, steps, scores, telemetry = gen(state, sub)
             jax.block_until_ready(scores)
         print(f"[{mode}] compiled; warmup steps={int(steps)}", file=sys.stderr)
 
-        t0 = time.perf_counter()
-        total_steps = 0
-        for _ in range(generations):
-            key, sub = jax.random.split(key)
-            state, steps, scores = gen(state, sub)
-            jax.block_until_ready(scores)
-            total_steps += int(steps)
-        elapsed = time.perf_counter() - t0
+        with track_compiles() as compile_log:
+            t0 = time.perf_counter()
+            total_steps = 0
+            for _ in range(generations):
+                key, sub = jax.random.split(key)
+                state, steps, scores, telemetry = gen(state, sub)
+                jax.block_until_ready(scores)
+                total_steps += int(steps)
+            elapsed = time.perf_counter() - t0
+        decoded = (
+            EvalTelemetry.from_array(telemetry) if telemetry is not None else None
+        )
         print(
             f"[{mode}] {generations} generations, {total_steps} env-steps in "
-            f"{elapsed:.2f}s; mean score {float(jnp.mean(scores)):.3f}",
+            f"{elapsed:.2f}s; mean score {float(jnp.mean(scores)):.3f}"
+            + (f"; {decoded.summary()}" if decoded is not None else "")
+            + (
+                f"; STEADY-STATE COMPILES: {compile_log.names}"
+                if compile_log.count
+                else ""
+            ),
             file=sys.stderr,
         )
-        return total_steps / elapsed, generations / elapsed, key
+        return (
+            total_steps / elapsed,
+            generations / elapsed,
+            key,
+            decoded,
+            compile_log.count,
+        )
 
     key = jax.random.key(0)
     modes = {}
@@ -172,13 +203,19 @@ def main():
         for m in ("budget", "episodes", "episodes_compact", "episodes_refill")
         if m != eval_mode
     ]
+    telemetry_by_mode = {}
+    steady_compiles = 0
     for mode in all_modes:
-        sps, gps, key = measure_mode(mode, key)
+        sps, gps, key, mode_telemetry, mode_compiles = measure_mode(mode, key)
+        telemetry_by_mode[mode] = mode_telemetry
+        steady_compiles += mode_compiles
         modes[mode] = {
             "value": round(sps, 1),
             "vs_baseline": round(sps / 1_000_000, 4),
             "generations_per_sec": round(gps, 3),
         }
+        if mode_telemetry is not None:
+            modes[mode]["occupancy"] = round(mode_telemetry.occupancy, 4)
 
     primary = modes[eval_mode]
     # the episodes-contract headline is the best runner of that contract
@@ -204,6 +241,21 @@ def main():
         "episodes_mode_vs_baseline": modes[episodes_key]["vs_baseline"],
         "compaction_speedup": speedup_vs_episodes("episodes_compact"),
         "refill_speedup": speedup_vs_episodes("episodes_refill"),
+        # on-device eval telemetry (observability.devicemetrics): the primary
+        # contract's occupancy, the refill scheduler's refill/wait accounting,
+        # and the retrace sentinel's steady-state compile count across every
+        # timed loop (anything but 0 is a retrace bug)
+        "occupancy": (
+            round(telemetry_by_mode[eval_mode].occupancy, 4)
+            if telemetry_by_mode.get(eval_mode) is not None
+            else None
+        ),
+        "refill_events": (
+            telemetry_by_mode["episodes_refill"].refill_events
+            if telemetry_by_mode.get("episodes_refill") is not None
+            else None
+        ),
+        "steady_compiles": steady_compiles,
         "modes": modes,
         "env": cfg["env_name"],
         "env_args": cfg["env_kwargs"],

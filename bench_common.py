@@ -59,6 +59,10 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
             jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
         ),
         "eval_mode": os.environ.get("BENCH_EVAL_MODE", "budget"),
+        # BENCH_TELEMETRY=0 compiles the accumulator-free rollout programs —
+        # the A/B baseline proving the zero-sync telemetry costs nothing
+        # (docs/observability.md); default on
+        "telemetry": os.environ.get("BENCH_TELEMETRY", "1") != "0",
         # BENCH_LOWRANK=k: evaluate a low-rank-structured population of rank k
         # (the MXU path for wide policies, net/lowrank.py); 0 = dense
         "lowrank": int(os.environ.get("BENCH_LOWRANK", "0")),

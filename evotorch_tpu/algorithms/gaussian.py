@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import Problem, SolutionBatch
+from ..observability.tracer import span
 from ..distributions import (
     Distribution,
     ExpGaussian,
@@ -258,8 +259,10 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         shared-basis factored batches)."""
         problem = self._problem
         if self._num_interactions is None:
-            self._population = self._sample_population(self._popsize)
-            problem.evaluate(self._population)
+            with span("ask", "algo"):
+                self._population = self._sample_population(self._popsize)
+            with span("eval", "algo", popsize=self._popsize):
+                problem.evaluate(self._population)
             return
         first_count = int(problem.status.get("total_interaction_count", 0))
         batches = []
@@ -267,10 +270,12 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         prev_made = -1
         gen_basis = None
         while True:
-            batch = self._sample_population(self._popsize, basis=gen_basis)
+            with span("ask", "algo"):
+                batch = self._sample_population(self._popsize, basis=gen_basis)
             if self._lowrank_rank is not None and gen_basis is None:
                 gen_basis = batch.values.basis
-            problem.evaluate(batch)
+            with span("eval", "algo", popsize=len(batch)):
+                problem.evaluate(batch)
             batches.append(batch)
             total_popsize += len(batch)
             if self._popsize_max is not None and total_popsize >= self._popsize_max:
@@ -359,20 +364,21 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         samples = pop.values
         fitnesses = pop.evals[:, self._obj_index]
         obj_sense = self._problem.senses[self._obj_index]
-        with jax.profiler.TraceAnnotation("evotorch_tpu.grad"):
-            grads = self._distribution.compute_gradients(
-                samples,
-                fitnesses,
-                objective_sense=obj_sense,
-                ranking_method=self._ranking_method if self._ranking_method is not None else "raw",
-            )
-        if self._lowrank_rank is not None:
-            # basis_capture guardrail: measured against the basis the
-            # gradient was just estimated in, BEFORE that gradient enters
-            # the direction EMA
-            self._update_basis_capture(samples.basis, grads["mu"])
-        with jax.profiler.TraceAnnotation("evotorch_tpu.update"):
-            self._update_distribution(grads)
+        with span("tell", "algo"):
+            with jax.profiler.TraceAnnotation("evotorch_tpu.grad"):
+                grads = self._distribution.compute_gradients(
+                    samples,
+                    fitnesses,
+                    objective_sense=obj_sense,
+                    ranking_method=self._ranking_method if self._ranking_method is not None else "raw",
+                )
+            if self._lowrank_rank is not None:
+                # basis_capture guardrail: measured against the basis the
+                # gradient was just estimated in, BEFORE that gradient enters
+                # the direction EMA
+                self._update_basis_capture(samples.basis, grads["mu"])
+            with jax.profiler.TraceAnnotation("evotorch_tpu.update"):
+                self._update_distribution(grads)
         with jax.profiler.TraceAnnotation("evotorch_tpu.ask"):
             self._fill_and_eval_pop()
         self._mean_eval = jnp.nanmean(self._population.evals[:, self._obj_index])
@@ -381,15 +387,16 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
     def _step_distributed(self):
         """Reference ``gaussian.py:199-272``: gather per-shard gradient dicts
         and average them (weighted by sub-population size when configured)."""
-        results = self._problem.sample_and_compute_gradients(
-            self._distribution,
-            self._popsize,
-            popsize_max=self._popsize_max,
-            num_interactions=self._num_interactions,
-            ranking_method=self._ranking_method if self._ranking_method is not None else "raw",
-            obj_index=self._obj_index,
-            lowrank_rank=self._lowrank_rank,
-        )
+        with span("sample_and_grad", "algo"):
+            results = self._problem.sample_and_compute_gradients(
+                self._distribution,
+                self._popsize,
+                popsize_max=self._popsize_max,
+                num_interactions=self._num_interactions,
+                ranking_method=self._ranking_method if self._ranking_method is not None else "raw",
+                obj_index=self._obj_index,
+                lowrank_rank=self._lowrank_rank,
+            )
         grads_list = [r["gradients"] for r in results]
         nums = np.asarray([r["num_solutions"] for r in results], dtype=np.float64)
         rel = nums / nums.sum()  # population-size weighting (host-side floats)
@@ -406,7 +413,8 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
             # estimator surfaces shard 0's basis as a representative iid
             # draw (capture statistics are exchangeable across shards)
             self._update_basis_capture(results[0]["basis"], avg["mu"])
-        self._update_distribution(avg)
+        with span("tell", "algo"):
+            self._update_distribution(avg)
 
     # --------------------------------------------------------------- updates
     def _update_distribution(self, gradients: dict):

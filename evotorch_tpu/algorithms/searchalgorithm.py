@@ -15,6 +15,8 @@ from typing import Optional
 import numpy as np
 
 from ..core import Problem
+from ..observability import counters, ensure_compile_counter
+from ..observability.tracer import span
 from ..tools.hook import Hook
 from ..tools.lazyreporter import LazyReporter, LazyStatusDict
 
@@ -32,6 +34,11 @@ class SearchAlgorithm(LazyReporter):
 
     def __init__(self, problem: Problem, **kwargs):
         super().__init__(**kwargs)
+        # session-wide compile accounting (observability.registry): from the
+        # first searcher on, every XLA compile in the process increments the
+        # `compiles` counter — step() publishes the per-generation delta, so
+        # a steady-state retrace is visible in every logger for free
+        ensure_compile_counter()
         self._problem = problem
         self._before_step_hook = Hook()
         self._after_step_hook = Hook()
@@ -113,7 +120,10 @@ class SearchAlgorithm(LazyReporter):
     def step(self):
         """One generation (reference ``searchalgorithm.py:380-397``).
         Beyond the reference, per-generation wall-clock is published as
-        ``step_seconds`` (SURVEY.md §5: the reference has no tracing beyond
+        ``step_seconds``, and the observability registry's per-step deltas
+        as ``compiles`` / ``trace_spans`` / ``telemetry_fetches`` — a
+        nonzero ``compiles`` after warmup IS a steady-state retrace
+        (SURVEY.md §5: the reference has no tracing beyond
         ``first_step_datetime``)."""
         import time
 
@@ -121,11 +131,14 @@ class SearchAlgorithm(LazyReporter):
         self.clear_status()
         if self._first_step_datetime is None:
             self._first_step_datetime = datetime.now()
+        meters = counters.snapshot(("compiles", "trace_spans", "telemetry_fetches"))
         t0 = time.perf_counter()
-        self._step()
+        with span("generation", "algo", n=self._steps_count + 1):
+            self._step()
         step_seconds = time.perf_counter() - t0
         self._steps_count += 1
         self.update_status({"iter": self._steps_count, "step_seconds": step_seconds})
+        self.update_status(counters.delta(meters))
         # refresh the lazy problem-status passthrough (see get_status_value)
         self._problem_status_keys = tuple(self._problem.iter_status_keys())
         extra = self._after_step_hook.accumulate_dict()

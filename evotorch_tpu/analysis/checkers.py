@@ -1,4 +1,4 @@
-"""The graftlint checkers — six JAX-specific static analyses.
+"""The graftlint checkers — seven JAX-specific static analyses.
 
 =============  ==============================================================
 checker        what it catches
@@ -22,6 +22,10 @@ checker        what it catches
 ``dtype``      float64/int64 leaks into the f32/bf16 compute path: x64 dtype
                references, ``dtype="float64"`` strings, np 64-bit constants
                materialized inside traced code
+``timing``     ``time.*()`` measurement regions around calls to jitted
+               callables with no ``block_until_ready()`` in the region —
+               such timings measure async dispatch, not the computation
+               (unsynced-timing bugs)
 =============  ==============================================================
 
 All checkers are pure-AST (no imports executed). Each returns
@@ -887,6 +891,134 @@ def check_dtype(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# (g) unsynced timing
+# ---------------------------------------------------------------------------
+
+#: wall-clock sources a benchmark region starts/ends with
+_TIME_FUNCS = {
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.process_time",
+}
+
+
+def _collect_jitted_names(mod: ModuleInfo) -> Set[str]:
+    """Names that are jitted callables in this module: ``x = jax.jit(...)``
+    bindings and ``@jax.jit``-decorated defs."""
+    jitted: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit_call(mod, node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        jitted.add(tgt.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _jit_decoration(mod, node) is not None:
+                jitted.add(node.name)
+    return jitted
+
+
+def _contains_block_until_ready(mod: ModuleInfo, root: ast.AST) -> bool:
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "block_until_ready":
+                return True
+    return False
+
+
+def check_timing(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
+    """jax dispatch is asynchronous: ``t0 = time.perf_counter(); jitted(...);
+    dt = time.perf_counter() - t0`` measures how fast the host *enqueued* the
+    work, not how long it ran. Flag every timing region (two or more
+    ``time.*()`` reads in one scope) that contains calls to known-jitted
+    callables but no ``block_until_ready`` — neither directly in the region
+    nor inside a locally-defined helper the region calls."""
+    findings: List[Finding] = []
+    jitted = _collect_jitted_names(mod)
+    if not jitted:
+        return findings
+
+    scopes: List[ast.AST] = [mod.tree]
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append(node)
+
+    for scope in scopes:
+        owner = scope if scope is not mod.tree else None
+        time_calls = []
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.enclosing_function(node) is not owner:
+                continue  # nested defs are their own timing scopes
+            if (mod.canon(node.func) or "") in _TIME_FUNCS:
+                time_calls.append(node)
+        if len(time_calls) < 2:
+            continue
+        first = min(c.lineno for c in time_calls)
+        last = max(c.lineno for c in time_calls)
+
+        region_jitted: List[str] = []
+        synced = False
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            # only calls the region itself EXECUTES count — a nested def
+            # merely *defined* between the clock reads neither dispatches
+            # nor syncs until it is called (same owner filter as the
+            # time-call scan above)
+            if mod.enclosing_function(node) is not owner:
+                continue
+            if not (first <= getattr(node, "lineno", 0) <= last):
+                continue
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "block_until_ready":
+                synced = True
+                break
+            if isinstance(node.func, ast.Name):
+                name = mod.name_aliases.get(node.func.id, node.func.id)
+                if name in jitted:
+                    region_jitted.append(name)
+                    continue
+                # a locally-defined helper CALLED in the region contributes
+                # what its body does: a block inside counts as the region's
+                # sync (`once()` patterns), a jitted dispatch inside counts
+                # as region jitted activity
+                local = _resolve_local_def(mod, scope, name)
+                if local is None:
+                    continue
+                if _contains_block_until_ready(mod, local):
+                    synced = True
+                    break
+                for sub in ast.walk(local):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and mod.name_aliases.get(sub.func.id, sub.func.id) in jitted
+                    ):
+                        region_jitted.append(name)
+                        break
+        if synced or not region_jitted:
+            continue
+        callee = sorted(set(region_jitted))[0]
+        findings.append(
+            mod.finding(
+                "timing",
+                time_calls[-1],
+                f"time.*() measurement around jitted `{callee}` with no "
+                "block_until_ready() in the region: async dispatch makes this "
+                "measure enqueue time, not compute time — block on the result "
+                "before reading the clock",
+                f"unsynced-timing:{callee}",
+            )
+        )
+    return findings
+
+
 CHECKERS = {
     "prng": check_prng,
     "retrace": check_retrace,
@@ -894,4 +1026,5 @@ CHECKERS = {
     "donation": check_donation,
     "axis-name": check_axis_names,
     "dtype": check_dtype,
+    "timing": check_timing,
 }

@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...observability import tracer
+from ...observability.tracer import span
 from .rl import alive_bonus_for_step_host
 from .vecrl import reset_tensors
 
@@ -347,14 +349,19 @@ class _PhysicsWorker:
             task = self._tasks.get()
             if task is None:
                 return
-            actions, active = task
+            actions, active, label = task
             try:
-                self._results.put(("ok", self._vec_env.step(actions, active=active)))
+                # the physics track: this span lives on the WORKER thread's
+                # tid, so in a Perfetto view it overlaps the main thread's
+                # device-forward spans — the pipeline's whole point, visible
+                with span("physics", "pipeline", block=label):
+                    result = self._vec_env.step(actions, active=active)
+                self._results.put(("ok", result))
             except BaseException as exc:  # surfaced on the main thread
                 self._results.put(("error", exc))
 
-    def submit(self, actions, active):
-        self._tasks.put((actions, active))
+    def submit(self, actions, active, label=None):
+        self._tasks.put((actions, active, label))
 
     def result(self):
         status, payload = self._results.get()
@@ -386,10 +393,12 @@ class _LaneBlock:
 
     __slots__ = (
         "lanes", "sl", "item", "active", "obs", "states", "fwd", "pending_states",
-        "iters", "sol_idx_dev", "full_actions", "full_active",
+        "iters", "sol_idx_dev", "full_actions", "full_active", "index", "fwd_t0",
     )
 
-    def __init__(self, lanes: np.ndarray, items: np.ndarray, obs: np.ndarray, states, num_envs: int, act_shape, act_dtype):
+    def __init__(self, lanes: np.ndarray, items: np.ndarray, obs: np.ndarray, states, num_envs: int, act_shape, act_dtype, index: int = 0):
+        self.index = index  # block number (trace-span labeling only)
+        self.fwd_t0 = None  # trace clock at forward dispatch (tracing only)
         self.lanes = lanes  # global lane indices, (w,) — contiguous
         self.sl = slice(int(lanes[0]), int(lanes[-1]) + 1)  # view, not copy
         self.item = items  # global item id per lane, -1 = exhausted, (w,)
@@ -448,7 +457,15 @@ def run_host_pipelined_rollout(
 
     Returns ``{"scores" (P,), "interactions", "episodes",
     "episode_steps" (P, num_episodes), "lane_episodes" (num_envs,),
-    "block_iters" [per-block lockstep iteration counts]}``.
+    "block_iters" [per-block lockstep iteration counts],
+    "occupancy" [counted interactions / executed lane-step slots]}``.
+
+    With tracing on (``EVOTORCH_TRACE`` / ``observability.tracer``), each
+    scheduler stage emits a span — ``s1.forward_dispatch``,
+    ``s2.actions_sync`` (the device sync), ``s3.bookkeep_refill``,
+    ``physics_wait`` — plus a ``device_forward`` span covering each block's
+    dispatch->materialize window; the worker thread's ``physics`` spans land
+    on their own track, so S1/S2/S3 overlap is directly visible in Perfetto.
     """
     if mode not in ("pipelined", "sync"):
         raise ValueError(f"mode must be 'pipelined' or 'sync', got {mode!r}")
@@ -496,7 +513,7 @@ def run_host_pipelined_rollout(
     all_obs = vec_env.reset()[:width]
     proto = policy.initial_state()
     blocks: List[_LaneBlock] = []
-    for lanes in np.array_split(np.arange(width), num_blocks):
+    for bi, lanes in enumerate(np.array_split(np.arange(width), num_blocks)):
         lanes = lanes.astype(np.int64)
         if proto is None:
             states = None
@@ -507,7 +524,7 @@ def run_host_pipelined_rollout(
         blocks.append(
             _LaneBlock(
                 lanes, lanes.copy(), all_obs[lanes], states, vec_env.num_envs,
-                act_shape, np.int64 if discrete else np.float64,
+                act_shape, np.int64 if discrete else np.float64, index=bi,
             )
         )
         lane_episodes[lanes] += 1
@@ -517,45 +534,68 @@ def run_host_pipelined_rollout(
 
     # ---- stages -------------------------------------------------------------
     def s1_dispatch_forward(blk: _LaneBlock):
-        norm_obs = blk.obs
-        if obs_stats is not None and obs_stats.count >= 2:
-            norm_obs = np.asarray(obs_stats.normalize(norm_obs), dtype=np.float32)
-        # unconditional, matching the reference loop: scrubs both the NaN
-        # dummy rows of exhausted lanes AND non-finite observations from
-        # diverged physics on live lanes (no-termination families)
-        norm_obs = np.nan_to_num(norm_obs)
-        if blk.sol_idx_dev is None:  # refreshed only after a refill/exhaustion
-            blk.sol_idx_dev = np.where(blk.item >= 0, blk.item // episodes_per_solution, 0)
-        # numpy arguments go straight into the jitted call: jit's own arg
-        # transfer is ~3x cheaper than a separate jnp.asarray dispatch here
-        if blk.states is None:
-            blk.fwd = _forward_gather_stateless(
-                policy, params_batch, blk.sol_idx_dev, norm_obs
-            )
-        else:
-            blk.fwd = _forward_gather_stateful(
-                policy, params_batch, blk.sol_idx_dev, norm_obs, blk.states
-            )
+        with span("s1.forward_dispatch", "pipeline", block=blk.index):
+            norm_obs = blk.obs
+            if obs_stats is not None and obs_stats.count >= 2:
+                norm_obs = np.asarray(obs_stats.normalize(norm_obs), dtype=np.float32)
+            # unconditional, matching the reference loop: scrubs both the NaN
+            # dummy rows of exhausted lanes AND non-finite observations from
+            # diverged physics on live lanes (no-termination families)
+            norm_obs = np.nan_to_num(norm_obs)
+            if blk.sol_idx_dev is None:  # refreshed only after a refill/exhaustion
+                blk.sol_idx_dev = np.where(blk.item >= 0, blk.item // episodes_per_solution, 0)
+            # numpy arguments go straight into the jitted call: jit's own arg
+            # transfer is ~3x cheaper than a separate jnp.asarray dispatch here
+            if blk.states is None:
+                blk.fwd = _forward_gather_stateless(
+                    policy, params_batch, blk.sol_idx_dev, norm_obs
+                )
+            else:
+                blk.fwd = _forward_gather_stateful(
+                    policy, params_batch, blk.sol_idx_dev, norm_obs, blk.states
+                )
+        trace = tracer.get_tracer()
+        if trace is not None:
+            blk.fwd_t0 = trace.now_us()
 
     def s2_submit_physics(blk: _LaneBlock, worker: Optional[_PhysicsWorker]):
-        out, new_states = blk.fwd
-        blk.fwd = None
-        blk.pending_states = new_states
-        out = np.asarray(out)  # the swap point: the pipeline's only device sync
-        if discrete:
-            actions = np.argmax(out, axis=-1)
-        else:
-            actions = out.astype(np.float64).reshape((len(blk.lanes),) + act_shape)
-            if action_noise_stdev is not None:
-                actions = actions + rng.normal(size=actions.shape) * float(action_noise_stdev)
-            actions = np.clip(actions, act_space.low, act_space.high)
-        blk.full_actions[blk.sl] = actions
+        with span("s2.actions_sync", "pipeline", block=blk.index):
+            out, new_states = blk.fwd
+            blk.fwd = None
+            blk.pending_states = new_states
+            out = np.asarray(out)  # the swap point: the pipeline's only device sync
+            trace = tracer.get_tracer()
+            if trace is not None and blk.fwd_t0 is not None:
+                # the dispatched forward's lifetime, dispatch -> materialize:
+                # the host-visible "device forward" span the physics track
+                # overlaps with
+                trace.complete(
+                    "device_forward",
+                    blk.fwd_t0,
+                    trace.now_us() - blk.fwd_t0,
+                    "pipeline",
+                    block=blk.index,
+                )
+                blk.fwd_t0 = None
+            if discrete:
+                actions = np.argmax(out, axis=-1)
+            else:
+                actions = out.astype(np.float64).reshape((len(blk.lanes),) + act_shape)
+                if action_noise_stdev is not None:
+                    actions = actions + rng.normal(size=actions.shape) * float(action_noise_stdev)
+                actions = np.clip(actions, act_space.low, act_space.high)
+            blk.full_actions[blk.sl] = actions
         if worker is not None:
-            worker.submit(blk.full_actions, blk.full_active)
+            worker.submit(blk.full_actions, blk.full_active, blk.index)
             return None
-        return vec_env.step(blk.full_actions, active=blk.full_active)
+        with span("physics", "pipeline", block=blk.index):  # sync mode: inline
+            return vec_env.step(blk.full_actions, active=blk.full_active)
 
     def s3_bookkeep_and_refill(blk: _LaneBlock, step_result):
+        with span("s3.bookkeep_refill", "pipeline", block=blk.index):
+            _s3_inner(blk, step_result)
+
+    def _s3_inner(blk: _LaneBlock, step_result):
         nonlocal interactions, episodes_finished, next_item
         obs_full, rewards_full, dones_full = step_result
         obs = obs_full[blk.sl]
@@ -647,7 +687,10 @@ def run_host_pipelined_rollout(
             ):
                 prev, result = inflight.popleft()
                 if result is None:
-                    result = worker.result()
+                    # main-thread stall waiting on the worker: visible in a
+                    # trace as the gap the pipeline exists to shrink
+                    with span("physics_wait", "pipeline", block=prev.index):
+                        result = worker.result()
                 s3_bookkeep_and_refill(prev, result)
                 if prev.active.any():
                     s1_dispatch_forward(prev)
@@ -657,6 +700,10 @@ def run_host_pipelined_rollout(
         if worker is not None:
             worker.close()
 
+    # lane-step slots executed = per-block width x lockstep iterations; the
+    # fraction that were counted interactions is the host-path occupancy
+    # (the same figure the on-device engines report — docs/observability.md)
+    capacity = sum(len(blk.lanes) * blk.iters for blk in blocks)
     return {
         "scores": item_return.reshape(num_solutions, episodes_per_solution).mean(axis=1),
         "interactions": interactions,
@@ -664,4 +711,5 @@ def run_host_pipelined_rollout(
         "episode_steps": item_steps.reshape(num_solutions, episodes_per_solution),
         "lane_episodes": lane_episodes,
         "block_iters": [blk.iters for blk in blocks],
+        "occupancy": interactions / capacity if capacity else 0.0,
     }

@@ -38,6 +38,7 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ...observability.devicemetrics import pack_eval_telemetry
 from ..net.functional import FlatParamsPolicy
 from ..net.lowrank import LowRankParamsBatch, lowrank_forward, prepare_lowrank
 from ..net.rl import alive_bonus_for_step
@@ -193,6 +194,11 @@ class RolloutResult(NamedTuple):
     stats: CollectedStats  # obs-norm statistics collected during the rollout
     total_steps: jnp.ndarray  # scalar: total env interactions
     total_episodes: jnp.ndarray  # scalar: episodes finished
+    # packed on-device eval telemetry (observability.devicemetrics): one
+    # (TELEMETRY_WIDTH,) int32 vector computed inside the same jitted program
+    # as the scores — fetching it is part of the same transfer, never a new
+    # dispatch. None when the engine ran with telemetry=False.
+    telemetry: Any = None
 
 
 class RolloutCarry(NamedTuple):
@@ -213,6 +219,10 @@ class RolloutCarry(NamedTuple):
     key: Any
     total_steps: jnp.ndarray
     t_global: jnp.ndarray
+    # lane-step slots executed (working width summed over iterations): the
+    # occupancy denominator (observability.devicemetrics); frozen at its
+    # initial zero when the engine runs with telemetry off
+    capacity: jnp.ndarray
 
 
 def _policy_to_action(raw, action_space, noise, clip: bool):
@@ -339,6 +349,7 @@ def _rollout_init(
         key=lane_keys,  # (n,) per-lane PRNG chains
         total_steps=jnp.zeros((), dtype=jnp.int32),
         t_global=jnp.zeros((), dtype=jnp.int32),
+        capacity=jnp.zeros((), dtype=jnp.int32),
     )
     return carry, params_batch
 
@@ -366,10 +377,15 @@ def _make_step(
     compute_dtype,
     budget_mode: bool,
     stats_sync_axis=None,
+    collect_telemetry: bool = True,
 ):
     """One masked control step of the whole population, as a pure function
     ``step(params_batch, carry) -> carry``. Width is taken from the carry, so
     the same step serves the monolithic loop and every compacted width.
+
+    ``collect_telemetry``: accumulate the observability counters (one extra
+    int32 scalar add per step — the ``capacity`` carry); False freezes the
+    telemetry fields so an A/B against a telemetry-free program is possible.
 
     ``stats_sync_axis``: inside a ``shard_map`` over that axis, psum-merge
     the per-step observation-statistic deltas so every shard normalizes by
@@ -497,6 +513,9 @@ def _make_step(
             key=lane_keys,
             total_steps=total_steps,
             t_global=c.t_global + 1,
+            # telemetry: every iteration executes `n` lane-step slots,
+            # whether the lanes are live or idling masked
+            capacity=(c.capacity + n) if collect_telemetry else c.capacity,
         )
 
     return step
@@ -519,6 +538,7 @@ def _make_step(
         "refill_width",
         "refill_period",
         "seed_stride",
+        "telemetry",
     ),
 )
 def run_vectorized_rollout(
@@ -541,8 +561,17 @@ def run_vectorized_rollout(
     refill_width: Optional[int] = None,
     refill_period: int = 1,
     seed_stride: Optional[int] = None,
+    telemetry: bool = True,
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
+
+    ``telemetry`` (default on): accumulate the zero-sync observability
+    counters in the loop carry and return them packed in
+    ``RolloutResult.telemetry`` — a ``(TELEMETRY_WIDTH,)`` int32 vector
+    produced by the same jitted program as the scores (zero extra
+    dispatches; see ``observability.devicemetrics``). ``telemetry=False``
+    compiles the accumulator-free program — the A/B baseline for measuring
+    that the accumulators cost nothing.
 
     Randomness is a PER-LANE property: lane ``i``'s PRNG chain is seeded by
     ``fold_in(key, lane_ids[i])`` (default ``lane_ids = arange(N)``) and
@@ -637,6 +666,7 @@ def run_vectorized_rollout(
             refill_width=refill_width,
             refill_period=refill_period,
             seed_stride=seed_stride,
+            telemetry=telemetry,
         )
     hard_cap = max_t * int(num_episodes) + 1
     budget_mode = eval_mode == "budget"
@@ -664,6 +694,7 @@ def run_vectorized_rollout(
         compute_dtype=compute_dtype,
         budget_mode=budget_mode,
         stats_sync_axis=stats_sync_axis,
+        collect_telemetry=telemetry,
     )
 
     ctx = _forward_ctx(policy, params_batch)
@@ -694,11 +725,22 @@ def run_vectorized_rollout(
 
         final = jax.lax.while_loop(cond, lambda c: step(params_batch, ctx, c), carry)
         mean_scores = final.scores / jnp.maximum(final.episodes_done, 1)
+    total_episodes = jnp.sum(final.episodes_done)
     return RolloutResult(
         scores=mean_scores,
         stats=final.stats,
         total_steps=final.total_steps,
-        total_episodes=jnp.sum(final.episodes_done),
+        total_episodes=total_episodes,
+        telemetry=(
+            pack_eval_telemetry(
+                env_steps=final.total_steps,
+                episodes=total_episodes,
+                capacity=final.capacity,
+                lane_width=final.active.shape[0],
+            )
+            if telemetry
+            else None
+        ),
     )
 
 
@@ -744,6 +786,12 @@ class RefillCarry(NamedTuple):
     key: Any  # (W,) per-lane PRNG chains
     total_steps: jnp.ndarray
     t_global: jnp.ndarray
+    # telemetry accumulators (observability.devicemetrics): lane-step slots
+    # executed, and lane-steps spent idle while pending work existed (the
+    # refill-period / drain-ordering wait — starvation accounting). Frozen at
+    # zero when the engine runs with telemetry off.
+    capacity: jnp.ndarray
+    wait_sum: jnp.ndarray
 
 
 def _default_refill_width(total_items: int) -> int:
@@ -823,6 +871,7 @@ def _run_refill(
     refill_width,
     refill_period,
     seed_stride,
+    telemetry=True,
 ) -> RolloutResult:
     """The ``episodes_refill`` evaluation: exact ``episodes`` semantics (each
     solution is scored by the mean return of exactly ``num_episodes``
@@ -891,6 +940,8 @@ def _run_refill(
         key=chain0,
         total_steps=jnp.zeros((), dtype=jnp.int32),
         t_global=jnp.zeros((), dtype=jnp.int32),
+        capacity=jnp.zeros((), dtype=jnp.int32),
+        wait_sum=jnp.zeros((), dtype=jnp.int32),
     )
 
     def step(c: RefillCarry) -> RefillCarry:
@@ -1007,6 +1058,20 @@ def _run_refill(
         active = running | take
         next_item = c.next_item + jnp.sum(take.astype(jnp.int32))
 
+        if telemetry:
+            # telemetry: each iteration executes W lane-step slots; lanes
+            # idle AFTER this step's refill while the queue still holds work
+            # are waiting on the refill gate / drain order (the
+            # starvation-accounting numerator)
+            capacity = c.capacity + jnp.int32(width)
+            wait_sum = c.wait_sum + jnp.where(
+                next_item < total_items,
+                jnp.sum((~active).astype(jnp.int32)),
+                0,
+            )
+        else:
+            capacity, wait_sum = c.capacity, c.wait_sum
+
         # obs-norm statistics count ONLY live-lane observations: the
         # post-refill obs each still-active lane will consume next step
         # (idle/drained lanes are masked out entirely)
@@ -1034,6 +1099,8 @@ def _run_refill(
             key=keys_next,
             total_steps=total_steps,
             t_global=c.t_global + 1,
+            capacity=capacity,
+            wait_sum=wait_sum,
         )
 
     # greedy-scheduling makespan bound (total work / W + longest item) plus
@@ -1060,11 +1127,26 @@ def _run_refill(
 
     final = jax.lax.while_loop(cond, step, carry)
     mean_scores = final.scores_buf / jnp.maximum(final.eps_buf, 1).astype(jnp.float32)
+    total_episodes = jnp.sum(final.eps_buf)
     return RolloutResult(
         scores=mean_scores,
         stats=final.stats,
         total_steps=final.total_steps,
-        total_episodes=jnp.sum(final.eps_buf),
+        total_episodes=total_episodes,
+        telemetry=(
+            pack_eval_telemetry(
+                env_steps=final.total_steps,
+                episodes=total_episodes,
+                capacity=final.capacity,
+                lane_width=width,
+                # items 0..width-1 seeded the lanes; everything past that
+                # entered through the refill gather
+                refill_events=final.next_item - jnp.int32(width),
+                queue_wait=final.wait_sum,
+            )
+            if telemetry
+            else None
+        ),
     )
 
 
@@ -1081,6 +1163,7 @@ def _compacting_fns(
     action_noise_stdev,
     compute_dtype,
     stats_sync_axis=None,
+    collect_telemetry=True,
 ):
     """Jitted building blocks of the compacting runner, cached per config so
     repeated calls (every generation) hit XLA's compile cache."""
@@ -1096,6 +1179,7 @@ def _compacting_fns(
         compute_dtype=compute_dtype,
         budget_mode=False,
         stats_sync_axis=stats_sync_axis,
+        collect_telemetry=collect_telemetry,
     )
 
     @jax.jit
@@ -1158,6 +1242,7 @@ def _compacting_fns(
             key=carry.key[sel],  # per-lane chains travel with their lanes
             total_steps=carry.total_steps,
             t_global=carry.t_global,
+            capacity=carry.capacity,  # capacity already paid at prior widths
         )
         return new_carry, _params_take(params_batch, sel), lane_ids[sel], scores_buf, eps_buf
 
@@ -1166,7 +1251,20 @@ def _compacting_fns(
         scores_buf = scores_buf.at[lane_ids].set(carry.scores)
         eps_buf = eps_buf.at[lane_ids].set(carry.episodes_done)
         mean_scores = scores_buf / jnp.maximum(eps_buf, 1)
-        return mean_scores, jnp.sum(eps_buf)
+        total_episodes = jnp.sum(eps_buf)
+        telemetry = (
+            pack_eval_telemetry(
+                env_steps=carry.total_steps,
+                episodes=total_episodes,
+                # carry.capacity summed width x iterations through every
+                # compaction, so occupancy credits the narrowing directly
+                capacity=carry.capacity,
+                lane_width=scores_buf.shape[0],
+            )
+            if collect_telemetry
+            else None
+        )
+        return mean_scores, total_episodes, telemetry
 
     return init_fn, chunk_fn, compact_fn, finalize_fn
 
@@ -1189,6 +1287,7 @@ def run_vectorized_rollout_compacting(
     min_width: Optional[int] = None,
     allowed_widths: Optional[tuple] = None,
     prewarm: bool = False,
+    telemetry: bool = True,
 ) -> RolloutResult:
     """Episodes-contract evaluation with **lane compaction** — the
     host-orchestrated fast path for ``eval_mode="episodes"``.
@@ -1250,6 +1349,7 @@ def run_vectorized_rollout_compacting(
         decrease_rewards_by,
         action_noise_stdev,
         compute_dtype,
+        collect_telemetry=bool(telemetry),
     )
 
     if allowed_widths is None:
@@ -1321,12 +1421,15 @@ def run_vectorized_rollout_compacting(
                 )
         prev_count = count
 
-    mean_scores, total_episodes = finalize_fn(carry, lane_ids, scores_buf, eps_buf)
+    mean_scores, total_episodes, eval_telemetry = finalize_fn(
+        carry, lane_ids, scores_buf, eps_buf
+    )
     return RolloutResult(
         scores=mean_scores,
         stats=carry.stats,
         total_steps=carry.total_steps,
         total_episodes=total_episodes,
+        telemetry=eval_telemetry,
     )
 
 
@@ -1352,6 +1455,7 @@ def _expand_shard_scalars(carry: "RolloutCarry") -> "RolloutCarry":
         stats=ex(carry.stats),
         total_steps=carry.total_steps[None],
         t_global=carry.t_global[None],
+        capacity=carry.capacity[None],
     )
 
 
@@ -1361,6 +1465,7 @@ def _squeeze_shard_scalars(carry: "RolloutCarry") -> "RolloutCarry":
         stats=sq(carry.stats),
         total_steps=carry.total_steps[0],
         t_global=carry.t_global[0],
+        capacity=carry.capacity[0],
     )
 
 
@@ -1387,6 +1492,7 @@ def _sharded_carry_specs(env, axis_name: str) -> "RolloutCarry":
         key=lane,
         total_steps=lane,
         t_global=lane,
+        capacity=lane,
     )
 
 
@@ -1424,6 +1530,7 @@ def _compacting_sharded_fns(
     axis_name: str,
     lowrank: bool,
     stats_sync: bool = False,
+    collect_telemetry: bool = True,
 ):
     from jax.sharding import PartitionSpec as P
 
@@ -1439,6 +1546,7 @@ def _compacting_sharded_fns(
         action_noise_stdev,
         compute_dtype,
         stats_sync_axis=axis_name if stats_sync else None,
+        collect_telemetry=collect_telemetry,
     )
     carry_specs = _sharded_carry_specs(env, axis_name)
     params_spec = _params_shard_spec(lowrank, axis_name)
@@ -1520,7 +1628,14 @@ def _compacting_sharded_fns(
 
     def sh_finalize_local(carry, lane_ids, scores_buf, eps_buf, stats0):
         c = _squeeze_shard_scalars(carry)
-        mean_scores, eps_total_local = finalize_fn(c, lane_ids, scores_buf, eps_buf)
+        mean_scores, eps_total_local, telemetry = finalize_fn(
+            c, lane_ids, scores_buf, eps_buf
+        )
+        if telemetry is None:
+            telemetry_out = jnp.zeros((0,), dtype=jnp.int32)
+        else:
+            # every slot is additive, so the mesh-global telemetry is one psum
+            telemetry_out = jax.lax.psum(telemetry, axis_name)
         if stats_sync:
             # per-step psum already made every shard's stats mesh-global; a
             # final delta merge would count every delta n_shards times
@@ -1542,6 +1657,7 @@ def _compacting_sharded_fns(
             # only, so it is invariant under compaction — compaction saves
             # wall-clock on dead lanes, not counted steps)
             c.total_steps[None],
+            telemetry_out,
         )
 
     sh_finalize = jax.jit(
@@ -1549,7 +1665,7 @@ def _compacting_sharded_fns(
             sh_finalize_local,
             mesh=mesh,
             in_specs=(carry_specs, lane, lane, lane, P()),
-            out_specs=(lane, P(), P(), P(), lane),
+            out_specs=(lane, P(), P(), P(), lane, P()),
             check_vma=False,
         )
     )
@@ -1579,6 +1695,7 @@ def run_vectorized_rollout_compacting_sharded(
     prewarm: bool = False,
     return_per_shard_steps: bool = False,
     stats_sync: bool = False,
+    telemetry: bool = True,
 ) -> RolloutResult:
     """``run_vectorized_rollout_compacting`` with the population sharded over
     ``mesh[axis_name]``: each device narrows ITS working set as its lanes
@@ -1627,6 +1744,7 @@ def run_vectorized_rollout_compacting_sharded(
         str(axis_name),
         isinstance(params_batch, LowRankParamsBatch),
         bool(stats_sync),
+        bool(telemetry),
     )
 
     if allowed_widths is None:
@@ -1689,14 +1807,15 @@ def run_vectorized_rollout_compacting_sharded(
                 )
         prev_counts = counts
 
-    mean_scores, merged_stats, total_steps, total_episodes, per_shard = sh_finalize(
-        carry, lane_ids, scores_buf, eps_buf, stats0
+    mean_scores, merged_stats, total_steps, total_episodes, per_shard, eval_telemetry = (
+        sh_finalize(carry, lane_ids, scores_buf, eps_buf, stats0)
     )
     result = RolloutResult(
         scores=mean_scores,
         stats=merged_stats,
         total_steps=total_steps,
         total_episodes=total_episodes,
+        telemetry=eval_telemetry if eval_telemetry.size else None,
     )
     if return_per_shard_steps:
         return result, per_shard

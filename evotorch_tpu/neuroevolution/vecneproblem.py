@@ -159,6 +159,13 @@ class VecNE(NEProblem):
         self._obs_norm = RunningNorm(self._env.observation_size)
         self._interaction_count = 0
         self._episode_count = 0
+        # zero-sync eval telemetry (observability.devicemetrics): the packed
+        # device vector of the CURRENT evaluation is only enqueued here; the
+        # PREVIOUS one — whose program has retired — is decoded lazily for
+        # the status dict (the same lag-by-one device-scalar discipline as
+        # basis_capture: the decode is a ~24-byte transfer, never a stall)
+        self._pending_telemetry = None
+        self._last_telemetry = None
 
         super().__init__(
             "max",
@@ -233,11 +240,28 @@ class VecNE(NEProblem):
         self._interaction_count = self._interaction_count + jax.device_put(steps, dev)
         self._episode_count = self._episode_count + jax.device_put(episodes, dev)
 
+    def _consume_telemetry(self, telemetry):
+        """Enqueue this evaluation's packed telemetry vector and decode the
+        previous one (already materialized — see the constructor note)."""
+        if telemetry is None:
+            return
+        from ..observability import EvalTelemetry
+
+        prev, self._pending_telemetry = self._pending_telemetry, telemetry
+        if prev is not None:
+            self._last_telemetry = EvalTelemetry.from_array(prev)
+
     def _report_counters(self, batch) -> dict:
-        return {
+        status = {
             "total_interaction_count": self._interaction_count,
             "total_episode_count": self._episode_count,
         }
+        if self._last_telemetry is not None:
+            # eval_occupancy / eval_refill_events / eval_queue_wait: the
+            # previous generation's figures (lag-by-one; shapes are identical
+            # generation to generation, so the diagnostics are current)
+            status.update(self._last_telemetry.as_status(prefix="eval_"))
+        return status
 
     # ------------------------------------------------------------ evaluation
     def _rollout_batch(self, values: jnp.ndarray, key) -> tuple:
@@ -329,6 +353,7 @@ class VecNE(NEProblem):
         if self._observation_normalization:
             self._obs_norm.stats = result.stats
         self._bump_counters(result.total_steps, result.total_episodes)
+        self._consume_telemetry(result.telemetry)
 
     # ------------------------------------------------------- policy exports
     def to_policy(self, solution) -> Module:
@@ -428,6 +453,7 @@ class VecNE(NEProblem):
             if obsnorm:
                 self._obs_norm.stats = result.stats
             self._bump_counters(result.total_steps, result.total_episodes)
+            self._consume_telemetry(result.telemetry)
             batch.set_evals(result.scores)
             self.update_status(self._report_counters(batch))
             return
@@ -482,6 +508,8 @@ class VecNE(NEProblem):
                 merged,
                 jax.lax.psum(result.total_steps, axis_name),
                 jax.lax.psum(result.total_episodes, axis_name),
+                # additive telemetry slots: the mesh-global vector is a psum
+                jax.lax.psum(result.telemetry, axis_name),
             )
 
         # a factored population shards its per-lane COEFFICIENTS over the
@@ -494,13 +522,16 @@ class VecNE(NEProblem):
             local,
             mesh=mesh,
             in_specs=(values_spec, P(), P()),
-            out_specs=(P(axis_name), P(), P(), P()),
+            out_specs=(P(axis_name), P(), P(), P(), P()),
             check_vma=False,
         )
-        scores, merged_stats, steps, episodes = sharded(values, self.next_rng_key(), stats)
+        scores, merged_stats, steps, episodes, telemetry = sharded(
+            values, self.next_rng_key(), stats
+        )
         if obsnorm:
             self._obs_norm.stats = jax.tree_util.tree_map(lambda x: x, merged_stats)
         self._bump_counters(steps, episodes)
+        self._consume_telemetry(telemetry)
         batch.set_evals(scores)
         self.update_status(self._report_counters(batch))
 

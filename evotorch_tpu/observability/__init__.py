@@ -1,0 +1,59 @@
+"""Zero-sync telemetry for the eval stack.
+
+Three cooperating pieces (docs/observability.md has the full catalog):
+
+- :mod:`~evotorch_tpu.observability.devicemetrics` — ON-DEVICE metric
+  accumulators: env-steps, episodes, lane capacity (occupancy), refill
+  events and queue-wait lane-steps, accumulated inside the existing
+  rollout ``lax.while_loop`` carries and returned as ONE packed ``(6,)``
+  int32 vector in the same device->host transfer as the scores. Zero
+  extra dispatches, zero retraces (sentinel-asserted in the fast tier).
+- :mod:`~evotorch_tpu.observability.tracer` — a host-side span tracer
+  emitting Chrome trace-event JSON loadable in Perfetto (ring-buffered;
+  a no-op singleton when disabled). Spans cover ask/eval/tell in the
+  search loop, the host pipeline's S1/S2/S3 stages + the physics worker
+  thread (overlap is visible as parallel tracks), and hostpool syncs.
+  Enable with ``EVOTORCH_TRACE=/path/to/trace.json`` or
+  :func:`~evotorch_tpu.observability.tracer.start_tracing`.
+- :mod:`~evotorch_tpu.observability.registry` — a process-wide counter
+  registry (``compiles`` via the session-wide promotion of
+  ``retrace_sentinel``'s compile counting, ``trace_spans``,
+  ``telemetry_fetches``) surfaced through searcher ``status`` dicts, so
+  ``StdOutLogger``/``PandasLogger`` pick everything up for free.
+"""
+
+from .devicemetrics import (  # noqa: F401
+    EvalTelemetry,
+    TELEMETRY_WIDTH,
+    pack_eval_telemetry,
+)
+from .registry import (  # noqa: F401
+    CounterRegistry,
+    counters,
+    ensure_compile_counter,
+)
+from .tracer import (  # noqa: F401
+    SpanTracer,
+    get_tracer,
+    instant,
+    span,
+    start_tracing,
+    stop_tracing,
+    tracing_enabled,
+)
+
+__all__ = [
+    "EvalTelemetry",
+    "TELEMETRY_WIDTH",
+    "pack_eval_telemetry",
+    "CounterRegistry",
+    "counters",
+    "ensure_compile_counter",
+    "SpanTracer",
+    "get_tracer",
+    "instant",
+    "span",
+    "start_tracing",
+    "stop_tracing",
+    "tracing_enabled",
+]

@@ -1,0 +1,140 @@
+"""On-device eval telemetry: the packed counter vector and its host decode.
+
+The zero-sync contract: every rollout engine accumulates its metrics as a
+few int32 scalars INSIDE the loop carry it already runs (no new programs,
+no host round-trips, no retraces — sentinel-asserted), and packs them into
+ONE ``(TELEMETRY_WIDTH,)`` int32 vector at the end of the jitted program.
+The vector rides out in ``RolloutResult.telemetry`` next to the scores, so
+fetching the whole telemetry of an evaluation is a single ~24-byte
+device->host transfer of an already-materialized output — and every slot is
+ADDITIVE, so sharded evaluations psum the vector and sub-batched
+evaluations just add them.
+
+Slots (``pack_eval_telemetry`` builds, :class:`EvalTelemetry` decodes):
+
+===================  =======================================================
+``env_steps``        counted env interactions (active lanes x steps)
+``episodes``         episodes finished
+``capacity``         lane-step slots the program executed (working width
+                     summed over loop iterations) — the denominator of
+                     occupancy; idle masked lanes burn capacity without
+                     producing env_steps
+``lane_width``       lanes at evaluation start (summed across shards)
+``refill_events``    (solution, episode) items loaded into a recycled lane
+                     by the refill scheduler (0 outside ``episodes_refill``)
+``queue_wait``       lane-steps spent idle while pending work existed —
+                     refill-period / drain-ordering waiting; the
+                     starvation-accounting numerator
+===================  =======================================================
+
+Derived: ``occupancy = env_steps / capacity`` (1.0 for the budget contract
+by construction; the idle-lane waste of plain ``episodes`` and the
+work-conservation of ``episodes_refill`` are directly visible here), and
+``mean_item_wait = queue_wait / refill_events``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .registry import counters
+
+__all__ = ["TELEMETRY_WIDTH", "pack_eval_telemetry", "EvalTelemetry"]
+
+#: packed vector layout (order is the wire format — append only)
+_SLOTS = (
+    "env_steps",
+    "episodes",
+    "capacity",
+    "lane_width",
+    "refill_events",
+    "queue_wait",
+)
+TELEMETRY_WIDTH = len(_SLOTS)
+
+
+def pack_eval_telemetry(
+    *,
+    env_steps,
+    episodes,
+    capacity,
+    lane_width,
+    refill_events=0,
+    queue_wait=0,
+):
+    """Stack the counters into the ``(TELEMETRY_WIDTH,)`` int32 wire vector
+    (call inside jit, on the final carry's scalars)."""
+    import jax.numpy as jnp
+
+    return jnp.stack(
+        [
+            jnp.asarray(env_steps, dtype=jnp.int32),
+            jnp.asarray(episodes, dtype=jnp.int32),
+            jnp.asarray(capacity, dtype=jnp.int32),
+            jnp.asarray(lane_width, dtype=jnp.int32),
+            jnp.asarray(refill_events, dtype=jnp.int32),
+            jnp.asarray(queue_wait, dtype=jnp.int32),
+        ]
+    )
+
+
+@dataclass(frozen=True)
+class EvalTelemetry:
+    """Host-side decode of one (or an accumulated sum of) telemetry vectors."""
+
+    env_steps: int = 0
+    episodes: int = 0
+    capacity: int = 0
+    lane_width: int = 0
+    refill_events: int = 0
+    queue_wait: int = 0
+
+    @classmethod
+    def from_array(cls, array) -> "EvalTelemetry":
+        """Decode a packed vector (device or host). The one device->host
+        transfer of the telemetry path — metered as a ``telemetry_fetches``
+        registry count so "zero extra transfers" stays auditable."""
+        values = np.asarray(array)
+        if values.shape != (TELEMETRY_WIDTH,):
+            raise ValueError(
+                f"expected a ({TELEMETRY_WIDTH},) telemetry vector, got shape"
+                f" {values.shape}"
+            )
+        counters.increment("telemetry_fetches")
+        return cls(**{name: int(values[i]) for i, name in enumerate(_SLOTS)})
+
+    def __add__(self, other: "EvalTelemetry") -> "EvalTelemetry":
+        if not isinstance(other, EvalTelemetry):
+            return NotImplemented
+        return EvalTelemetry(
+            **{name: getattr(self, name) + getattr(other, name) for name in _SLOTS}
+        )
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of executed lane-step slots that were genuine, counted
+        env interactions (0.0 when nothing ran)."""
+        return self.env_steps / self.capacity if self.capacity else 0.0
+
+    @property
+    def mean_item_wait(self) -> float:
+        """Mean idle lane-steps per refilled item — the refill-fairness /
+        starvation figure (0.0 without refills)."""
+        return self.queue_wait / self.refill_events if self.refill_events else 0.0
+
+    def as_status(self, prefix: str = "eval_") -> dict:
+        """The scalar status-dict form loggers pick up."""
+        return {
+            f"{prefix}occupancy": round(self.occupancy, 6),
+            f"{prefix}refill_events": self.refill_events,
+            f"{prefix}queue_wait": self.queue_wait,
+        }
+
+    def summary(self) -> str:
+        return (
+            f"env_steps={self.env_steps} episodes={self.episodes} "
+            f"occupancy={self.occupancy:.4f} lane_width={self.lane_width} "
+            f"refill_events={self.refill_events} queue_wait={self.queue_wait}"
+        )

@@ -115,7 +115,10 @@ def make_sharded_rollout_evaluator(
     - obs-norm statistics merged with a psum — per-step deltas when
       ``stats_sync=True`` (mesh-global cohort), else one end-of-rollout delta
       merge (shard-local cohorts, the reference's per-actor semantics);
-    - step/episode counters psum'd, per-shard counted steps returned.
+    - step/episode counters psum'd, per-shard counted steps returned;
+    - the packed observability telemetry vector psum'd to its mesh-global
+      form (all slots additive — ``observability.devicemetrics``), returned
+      in ``RolloutResult.telemetry``.
 
     Accepts dense ``(N, L)`` populations and factored
     ``LowRankParamsBatch``es (coefficients shard; center/basis replicate).
@@ -174,12 +177,19 @@ def make_sharded_rollout_evaluator(
                 merged = jax.tree_util.tree_map(
                     lambda old, d: old + jax.lax.psum(d, axis_name), stats, delta
                 )
+            if result.telemetry is None:
+                telemetry = jnp.zeros((0,), dtype=jnp.int32)
+            else:
+                # all telemetry slots are additive: the mesh-global
+                # observability vector is one psum, in the same program
+                telemetry = jax.lax.psum(result.telemetry, axis_name)
             return (
                 result.scores,
                 merged,
                 jax.lax.psum(result.total_steps, axis_name),
                 jax.lax.psum(result.total_episodes, axis_name),
                 result.total_steps[None],
+                telemetry,
             )
 
         values_spec = _params_shard_spec(lowrank, axis_name)
@@ -188,7 +198,7 @@ def make_sharded_rollout_evaluator(
                 local,
                 mesh=mesh,
                 in_specs=(values_spec, P(), P()),
-                out_specs=(P(axis_name), P(), P(), P(), P(axis_name)),
+                out_specs=(P(axis_name), P(), P(), P(), P(axis_name), P()),
                 check_vma=False,
             )
         )
@@ -202,9 +212,13 @@ def make_sharded_rollout_evaluator(
         lowrank = isinstance(values, LowRankParamsBatch)
         popsize = _params_popsize(values)
         fn = build(lowrank, popsize)
-        scores, merged, steps, episodes, per_shard = fn(values, key, stats)
+        scores, merged, steps, episodes, per_shard, telemetry = fn(values, key, stats)
         result = RolloutResult(
-            scores=scores, stats=merged, total_steps=steps, total_episodes=episodes
+            scores=scores,
+            stats=merged,
+            total_steps=steps,
+            total_episodes=episodes,
+            telemetry=telemetry if telemetry.size else None,
         )
         return result, per_shard
 

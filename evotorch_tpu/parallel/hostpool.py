@@ -34,6 +34,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability.tracer import span
+
 __all__ = ["HostEvaluatorPool"]
 
 _STARTUP_TIMEOUT = 300.0
@@ -193,36 +195,40 @@ class HostEvaluatorPool:
         import jax
 
         transport = []
-        for values in pieces_values:
-            if isinstance(values, jax.Array):  # jax array -> numpy for pickling
-                values = np.asarray(values)
-            transport.append(values)  # ObjectArray and ndarray both pickle
-        n = len(transport)
-        for i, v in enumerate(transport):
-            self._task_q.put(("eval", i, v, sync_data))
+        with span("hostpool.dispatch", "hostpool", pieces=len(pieces_values)):
+            for values in pieces_values:
+                if isinstance(values, jax.Array):  # jax array -> numpy for pickling
+                    values = np.asarray(values)
+                transport.append(values)  # ObjectArray and ndarray both pickle
+            n = len(transport)
+            for i, v in enumerate(transport):
+                self._task_q.put(("eval", i, v, sync_data))
         evals: List[Optional[np.ndarray]] = [None] * n
         sync_back: List[dict] = []
         received = 0
         deadline = None if self._timeout is None else time.monotonic() + self._timeout
-        while received < n:
-            try:
-                msg = self._result_q.get(timeout=1.0)
-            except Exception as e:
-                if not all(p.is_alive() for p in self._procs):
-                    raise RuntimeError(
-                        "a host evaluation worker died mid-evaluation"
-                    ) from e
-                if deadline is not None and time.monotonic() > deadline:
-                    raise RuntimeError("host evaluation pool timed out") from e
-                continue
-            status, idx, *payload = msg
-            if status != "ok":
-                raise RuntimeError(f"host evaluation worker failed:\n{payload[-1]}")
-            evals[idx] = payload[0]
-            sync_back.append(payload[1])
-            received += 1
-            if deadline is not None:
-                deadline = time.monotonic() + self._timeout  # progress resets it
+        # the actor-sync window: the main process blocks here gathering the
+        # per-piece results + obs-stat deltas from the worker processes
+        with span("hostpool.sync", "hostpool", pieces=n):
+            while received < n:
+                try:
+                    msg = self._result_q.get(timeout=1.0)
+                except Exception as e:
+                    if not all(p.is_alive() for p in self._procs):
+                        raise RuntimeError(
+                            "a host evaluation worker died mid-evaluation"
+                        ) from e
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise RuntimeError("host evaluation pool timed out") from e
+                    continue
+                status, idx, *payload = msg
+                if status != "ok":
+                    raise RuntimeError(f"host evaluation worker failed:\n{payload[-1]}")
+                evals[idx] = payload[0]
+                sync_back.append(payload[1])
+                received += 1
+                if deadline is not None:
+                    deadline = time.monotonic() + self._timeout  # progress resets it
         return evals, sync_back
 
     def shutdown(self):

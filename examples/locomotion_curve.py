@@ -162,6 +162,13 @@ def main():
                 # velocity norm (== max_speed) = step-size ceiling
                 "stdev_norm": float(jnp.linalg.norm(searcher.status["stdev"])),
                 "elapsed_s": round(time.time() - t_start, 1),
+                # zero-sync eval telemetry (docs/observability.md): lane
+                # occupancy + refill accounting of the previous generation's
+                # evaluation, and this step's compile count from the always-on
+                # registry — nonzero steady_compiles after gen 2 is a retrace
+                "occupancy": searcher.status.get("eval_occupancy"),
+                "refill_events": searcher.status.get("eval_refill_events"),
+                "steady_compiles": searcher.status.get("compiles"),
             }
             if hasattr(opt, "_velocity"):
                 row["clipup_velocity_norm"] = float(jnp.linalg.norm(opt._velocity))

@@ -13,31 +13,56 @@ mkdir -p "$OUT"
 # watcher heartbeats are operational noise, not results: the log lives at an
 # UNTRACKED path (gitignored) so probe lines never churn a round's commit
 LOG="$OUT/watch.log"
+# machine-readable telemetry twin of the human log: one JSON object per
+# probe/battery event (ts, event, healthy, platform), so TPU availability
+# history is queryable (jq '.[] | select(.healthy)') — same untracked dir
+EVENTS="$OUT/watch_events.jsonl"
 PROBE_SECONDS=${PROBE_SECONDS:-180}
 DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-11} * 3600 ))
 
 stamp() { date -u +%FT%TZ; }
 echo "$(stamp) watcher armed (pid $$, probe every ${PROBE_SECONDS}s)" >> "$LOG"
 
+# emit_event <event> <healthy:true|false> <platform-or-empty> [extra-json-kv]
+emit_event() {
+  local platform_json="null"
+  [ -n "$3" ] && platform_json="\"$3\""
+  printf '{"ts":"%s","event":"%s","healthy":%s,"platform":%s%s}\n' \
+    "$(stamp)" "$1" "$2" "$platform_json" "${4:+,$4}" >> "$EVENTS"
+}
+emit_event watcher_armed false "" "\"probe_seconds\":${PROBE_SECONDS}"
+
 # the probe must see a NON-CPU backend: on 2026-08-04 the axon plugin
 # stopped pinning the platform and jax fell back to CPU, so the bare
 # "import jax; jax.devices()" probe false-fired the battery onto the 1-core
 # CPU (cpu-fallback JSON + bogus .ok stamps, quarantined in
 # bench_curves/tpu_r5/false_fire_cpu_r6/). A dead tunnel still hangs the
-# probe (timeout -> unhealthy); a CPU fallback now fails the assert.
+# probe (timeout -> unhealthy); a CPU fallback now fails the assert. The
+# probe prints the observed platform so the JSONL event can distinguish a
+# silent CPU fallback (healthy=false, platform="cpu") from a dead tunnel
+# (healthy=false, platform=null).
+PROBE_PLATFORM=""
 probe_tpu() {
-  timeout 40 python -c \
-    "import jax; ds=jax.devices(); assert ds and ds[0].platform != 'cpu', ds; print(ds)" \
-    >/dev/null 2>&1
+  PROBE_PLATFORM=$(timeout 40 python -c \
+    "import jax; ds=jax.devices(); print(ds[0].platform if ds else '')" \
+    2>/dev/null | tail -n 1)
+  if [ -n "$PROBE_PLATFORM" ] && [ "$PROBE_PLATFORM" != "cpu" ]; then
+    emit_event probe true "$PROBE_PLATFORM"
+    return 0
+  fi
+  emit_event probe false "$PROBE_PLATFORM"
+  return 1
 }
 
 healthy_fails=0  # consecutive battery failures with the tunnel still healthy
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe_tpu; then
     echo "$(stamp) tunnel HEALTHY — firing battery" >> "$LOG"
+    emit_event battery_fired true "$PROBE_PLATFORM"
     bash scripts/tpu_window.sh >> "$LOG" 2>&1
     rc=$?
     echo "$(stamp) battery exited rc=$rc" >> "$LOG"
+    emit_event battery_exited true "$PROBE_PLATFORM" "\"rc\":$rc"
     [ "$rc" -eq 0 ] && exit 0
     if [ "$rc" -eq 3 ]; then
       # tunnel-caused abort: not the battery's fault; probe at normal cadence

@@ -1,0 +1,101 @@
+"""Capture a Perfetto trace of the pipelined real-MuJoCo host rollout.
+
+Runs ``run_host_pipelined_rollout`` over an ``MjVecEnv`` with the span
+tracer on and writes Chrome trace-event JSON: the main thread's
+``s1.forward_dispatch`` / ``s2.actions_sync`` / ``s3.bookkeep_refill`` +
+``device_forward`` spans on one track, the worker thread's ``physics``
+spans on another — the Sebulba overlap, visible. Open the file at
+https://ui.perfetto.dev. The committed reference trace lives at
+``bench_curves/hopper_v5_pipeline_trace_r8.json``.
+
+    python scripts/trace_host_pipeline.py --out trace.json \
+        --env Hopper-v5 --popsize 48 --num-envs 16 --episode-length 200
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--env", default="Hopper-v5")
+    p.add_argument("--popsize", type=int, default=48)
+    p.add_argument("--num-envs", type=int, default=16)
+    p.add_argument("--episode-length", type=int, default=200)
+    # 2 blocks even on a 1-core box: the point of the trace is to SHOW the
+    # worker-thread physics overlapping the main thread's forward dispatch
+    # (mujoco.rollout releases the GIL, so the overlap is real even here)
+    p.add_argument("--blocks", type=int, default=2)
+    p.add_argument("--out", default="hopper_pipeline_trace.json")
+    args = p.parse_args()
+
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import gymnasium as gym
+    import jax.numpy as jnp
+    import numpy as np
+
+    from evotorch_tpu.envs.mujoco.mjvecenv import MjVecEnv
+    from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+    from evotorch_tpu.neuroevolution.net.hostvecenv import run_host_pipelined_rollout
+    from evotorch_tpu.observability import tracer
+
+    probe = gym.make(args.env)
+    obs_dim = int(np.prod(probe.observation_space.shape))
+    act_dim = int(np.prod(probe.action_space.shape))
+    probe.close()
+    policy = FlatParamsPolicy(
+        Linear(obs_dim, 64) >> Tanh() >> Linear(64, act_dim)
+    )
+    rng = np.random.default_rng(0)
+    params = jnp.asarray(
+        rng.normal(size=(args.popsize, policy.parameter_count)) * 0.5, jnp.float32
+    )
+
+    def fresh_vec():
+        vec = MjVecEnv(lambda: gym.make(args.env), args.num_envs)
+        vec.seed(range(1000, 1000 + args.num_envs))
+        return vec
+
+    # warmup OUTSIDE the trace: the jit compile would dwarf the steady-state
+    # spans the trace exists to show
+    vec = fresh_vec()
+    run_host_pipelined_rollout(
+        vec, policy, params, num_episodes=1, episode_length=3,
+        mode="pipelined", num_blocks=args.blocks,
+    )
+    vec.close()
+
+    t = tracer.start_tracing(args.out)
+    vec = fresh_vec()
+    result = run_host_pipelined_rollout(
+        vec, policy, params, num_episodes=1, episode_length=args.episode_length,
+        mode="pipelined", num_blocks=args.blocks,
+    )
+    vec.close()
+    path = tracer.stop_tracing()
+    print(
+        json.dumps(
+            {
+                "trace": path,
+                "events": len(t.events()),
+                "env": args.env,
+                "popsize": args.popsize,
+                "num_envs": args.num_envs,
+                "blocks": args.blocks,
+                "interactions": result["interactions"],
+                "episodes": result["episodes"],
+                "occupancy": round(result["occupancy"], 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

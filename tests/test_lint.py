@@ -451,6 +451,168 @@ def test_dtype_flags_enable_x64():
 
 
 # ---------------------------------------------------------------------------
+# timing
+# ---------------------------------------------------------------------------
+
+
+def test_timing_flags_unsynced_measurement_of_jitted_call():
+    findings = _lint(
+        """
+        import time
+
+        import jax
+
+        step = jax.jit(lambda s: s + 1)
+
+        def bench(state):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                state = step(state)
+            return time.perf_counter() - t0
+        """,
+        checkers=["timing"],
+    )
+    assert [f.detail for f in findings] == ["unsynced-timing:step"]
+    assert "block_until_ready" in findings[0].message
+
+
+def test_timing_accepts_block_until_ready_in_region():
+    findings = _lint(
+        """
+        import time
+
+        import jax
+
+        step = jax.jit(lambda s: s + 1)
+
+        def bench(state):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                state = step(state)
+            jax.block_until_ready(state)
+            return time.perf_counter() - t0
+        """,
+        checkers=["timing"],
+    )
+    assert findings == []
+
+
+def test_timing_accepts_blocking_local_helper():
+    # the `once()` pattern (scripts/tune_compact.py): the dispatch + block
+    # live inside a locally-defined helper the timed loop calls
+    findings = _lint(
+        """
+        import time
+
+        import jax
+
+        step = jax.jit(lambda s: s + 1)
+
+        def bench(state):
+            def once(s):
+                out = step(s)
+                jax.block_until_ready(out)
+                return out
+
+            once(state)
+            t0 = time.perf_counter()
+            for _ in range(10):
+                state = once(state)
+            return time.perf_counter() - t0
+        """,
+        checkers=["timing"],
+    )
+    assert findings == []
+
+
+def test_timing_ignores_nested_def_merely_defined_in_region():
+    # a helper DEFINED between the clock reads neither dispatches nor syncs:
+    # its body's jitted call must not create a finding, and a
+    # block_until_ready inside it must not excuse one
+    findings = _lint(
+        """
+        import time
+
+        import jax
+
+        step = jax.jit(lambda s: s + 1)
+
+        def defines_but_never_calls():
+            t0 = time.perf_counter()
+            def helper(s):
+                return step(s)
+            total = sum(range(100))
+            return time.perf_counter() - t0, helper, total
+
+        def dead_block_does_not_excuse(state):
+            t0 = time.perf_counter()
+            def never_called(s):
+                jax.block_until_ready(s)
+            state = step(state)
+            return state, time.perf_counter() - t0
+        """,
+        checkers=["timing"],
+    )
+    assert [f.detail for f in findings] == ["unsynced-timing:step"]
+    assert findings[0].symbol == "dead_block_does_not_excuse"
+
+
+def test_timing_flags_unsynced_helper_called_in_region():
+    # a called local helper contributes what its body does: jitted dispatch
+    # without a block inside -> the region is an unsynced measurement
+    findings = _lint(
+        """
+        import time
+
+        import jax
+
+        step = jax.jit(lambda s: s + 1)
+
+        def bench(state):
+            def once(s):
+                return step(s)
+
+            t0 = time.perf_counter()
+            for _ in range(10):
+                state = once(state)
+            return time.perf_counter() - t0
+        """,
+        checkers=["timing"],
+    )
+    assert [f.detail for f in findings] == ["unsynced-timing:once"]
+
+
+def test_timing_ignores_host_only_timing_and_jit_decorated_defs():
+    findings = _lint(
+        """
+        import time
+
+        import jax
+
+        @jax.jit
+        def step(state):
+            return state + 1
+
+        def host_bench():
+            t0 = time.perf_counter()
+            total = sum(range(100))
+            return time.perf_counter() - t0, total
+
+        def device_bench(state):
+            t0 = time.perf_counter()
+            state = step(state)
+            dt = time.perf_counter() - t0
+            return state, dt
+        """,
+        checkers=["timing"],
+    )
+    # host_bench times no jitted call; device_bench times the @jax.jit def
+    # without blocking -> exactly one finding
+    assert [f.detail for f in findings] == ["unsynced-timing:step"]
+    assert findings[0].symbol == "device_bench"
+
+
+# ---------------------------------------------------------------------------
 # scoped allow-comments
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,359 @@
+"""Zero-sync telemetry: device counters, span tracer, counter registry.
+
+The contracts under test (docs/observability.md):
+
+- the on-device telemetry vector is produced by the SAME jitted program as
+  the scores, is additive (sharded evals psum it), and its figures agree
+  with the ground-truth counters for every eval contract;
+- the Chrome-trace tracer emits schema-valid, properly-nesting events,
+  keeps threads on separate tracks, ring-buffers, and is a shared no-op
+  when disabled;
+- the registry counts compiles/spans/fetches process-wide and surfaces
+  per-step deltas in searcher status dicts.
+"""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evotorch_tpu.envs import CartPole
+from evotorch_tpu.neuroevolution.net import (
+    FlatParamsPolicy,
+    Linear,
+    Tanh,
+    run_vectorized_rollout,
+    run_vectorized_rollout_compacting,
+)
+from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+from evotorch_tpu.observability import (
+    EvalTelemetry,
+    TELEMETRY_WIDTH,
+    counters,
+    pack_eval_telemetry,
+    tracer,
+)
+
+POPSIZE = 8
+EPISODE_LENGTH = 16
+
+
+def _env_policy():
+    env = CartPole()
+    net = Linear(env.observation_size, env.action_size) >> Tanh()
+    return env, FlatParamsPolicy(net)
+
+
+@pytest.fixture
+def fresh_tracer():
+    t = tracer.start_tracing()
+    yield t
+    tracer.stop_tracing(write=False)
+
+
+# ---------------------------------------------------------------------------
+# device telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_pack_decode_roundtrip_and_addition():
+    vec = jax.jit(
+        lambda: pack_eval_telemetry(
+            env_steps=10, episodes=2, capacity=20, lane_width=4,
+            refill_events=3, queue_wait=5,
+        )
+    )()
+    assert vec.shape == (TELEMETRY_WIDTH,) and vec.dtype == jnp.int32
+    t = EvalTelemetry.from_array(vec)
+    assert (t.env_steps, t.episodes, t.capacity, t.lane_width) == (10, 2, 20, 4)
+    assert (t.refill_events, t.queue_wait) == (3, 5)
+    assert t.occupancy == 0.5
+    assert t.mean_item_wait == pytest.approx(5 / 3)
+    summed = t + t
+    assert summed.env_steps == 20 and summed.capacity == 40
+    assert summed.occupancy == 0.5  # additivity preserves the ratio
+    with pytest.raises(ValueError):
+        EvalTelemetry.from_array(np.zeros(3))
+
+
+def test_telemetry_figures_per_contract():
+    env, policy = _env_policy()
+    stats = RunningNorm(env.observation_size).stats
+    params = jax.random.normal(jax.random.key(0), (POPSIZE, policy.parameter_count))
+    key = jax.random.key(1)
+    common = dict(num_episodes=1, episode_length=EPISODE_LENGTH)
+
+    budget = run_vectorized_rollout(
+        env, policy, params, key, stats, eval_mode="budget", **common
+    )
+    t = EvalTelemetry.from_array(budget.telemetry)
+    # budget: every executed lane-step is a counted interaction, by definition
+    assert t.occupancy == 1.0
+    assert t.env_steps == int(budget.total_steps) == POPSIZE * EPISODE_LENGTH
+    assert t.lane_width == POPSIZE and t.refill_events == 0
+
+    episodes = run_vectorized_rollout(
+        env, policy, params, key, stats, eval_mode="episodes", **common
+    )
+    t = EvalTelemetry.from_array(episodes.telemetry)
+    assert t.env_steps == int(episodes.total_steps)
+    assert t.episodes == int(episodes.total_episodes) == POPSIZE
+    # idle masked lanes burn capacity: occupancy is the waste diagnostic
+    assert 0.0 < t.occupancy <= 1.0
+
+    refill = run_vectorized_rollout(
+        env, policy, params, key, stats, eval_mode="episodes_refill",
+        refill_width=4, **common,
+    )
+    t = EvalTelemetry.from_array(refill.telemetry)
+    assert t.lane_width == 4
+    assert t.refill_events == POPSIZE - 4  # every item beyond the seed set
+    assert t.env_steps == int(refill.total_steps)
+
+    compact = run_vectorized_rollout_compacting(
+        env, policy, params, key, stats, allowed_widths=(4,), **common
+    )
+    t = EvalTelemetry.from_array(compact.telemetry)
+    assert t.env_steps == int(compact.total_steps)
+    assert t.episodes == POPSIZE
+    # capacity through the width descent never exceeds full-width-forever
+    assert t.capacity <= POPSIZE * (EPISODE_LENGTH + 1)
+
+
+def test_telemetry_off_is_none_and_scores_identical():
+    env, policy = _env_policy()
+    stats = RunningNorm(env.observation_size).stats
+    params = jax.random.normal(jax.random.key(0), (POPSIZE, policy.parameter_count))
+    key = jax.random.key(1)
+    for mode, kw in [
+        ("budget", {}),
+        ("episodes", {}),
+        ("episodes_refill", {"refill_width": 4}),
+    ]:
+        on = run_vectorized_rollout(
+            env, policy, params, key, stats, num_episodes=1,
+            episode_length=EPISODE_LENGTH, eval_mode=mode, **kw,
+        )
+        off = run_vectorized_rollout(
+            env, policy, params, key, stats, num_episodes=1,
+            episode_length=EPISODE_LENGTH, eval_mode=mode, telemetry=False, **kw,
+        )
+        assert off.telemetry is None
+        assert jnp.array_equal(on.scores, off.scores), mode
+
+
+def test_sharded_evaluator_psums_telemetry():
+    from evotorch_tpu.parallel.evaluate import make_sharded_rollout_evaluator
+    from evotorch_tpu.parallel.mesh import default_mesh
+
+    env, policy = _env_policy()
+    stats = RunningNorm(env.observation_size).stats
+    params = jax.random.normal(jax.random.key(0), (POPSIZE, policy.parameter_count))
+    mesh = default_mesh(("pop",))
+    evaluator = make_sharded_rollout_evaluator(
+        env, policy, mesh=mesh, num_episodes=1, episode_length=EPISODE_LENGTH,
+        eval_mode="episodes_refill", refill_width=8,
+    )
+    result, _ = evaluator(params, jax.random.key(1), stats)
+    t = EvalTelemetry.from_array(result.telemetry)
+    # psum'd across shards: mesh-global figures
+    assert t.env_steps == int(result.total_steps)
+    assert t.episodes == int(result.total_episodes) == POPSIZE
+    assert t.lane_width == 8  # the GLOBAL refill width, summed over shards
+
+
+def test_refill_queue_wait_counts_gated_idle_lanes():
+    env, policy = _env_policy()
+    stats = RunningNorm(env.observation_size).stats
+    params = jax.random.normal(jax.random.key(0), (POPSIZE, policy.parameter_count))
+    # refill_period > 1 forces finished lanes to idle masked while the queue
+    # still holds work — exactly what queue_wait meters
+    r = run_vectorized_rollout(
+        env, policy, params, jax.random.key(1), stats, num_episodes=1,
+        episode_length=EPISODE_LENGTH, eval_mode="episodes_refill",
+        refill_width=2, refill_period=7,
+    )
+    t = EvalTelemetry.from_array(r.telemetry)
+    assert t.refill_events == POPSIZE - 2
+    assert t.queue_wait > 0
+    assert t.mean_item_wait > 0.0
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_schema_and_nesting(fresh_tracer):
+    with tracer.span("outer", "test", level=1):
+        with tracer.span("inner", "test"):
+            pass
+        tracer.instant("marker", "test")
+    events = fresh_tracer.events()
+    payload = json.loads(json.dumps(fresh_tracer.to_chrome_trace()))
+    assert set(payload.keys()) == {"traceEvents", "displayTimeUnit"}
+    by_name = {e["name"]: e for e in events if e.get("ph") != "M"}
+    for e in events:
+        assert isinstance(e["name"], str)
+        assert e["ph"] in ("X", "M", "i")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+    outer, inner = by_name["outer"], by_name["inner"]
+    # spans NEST: the inner complete event is contained in the outer one
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    assert outer["args"] == {"level": 1}
+    # a thread_name metadata event identifies the track
+    assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in events)
+
+
+def test_tracer_threads_get_separate_tracks(fresh_tracer):
+    def worker():
+        with tracer.span("in_thread", "test"):
+            pass
+
+    th = threading.Thread(target=worker, name="test-worker")
+    with tracer.span("in_main", "test"):
+        pass
+    th.start()
+    th.join()
+    events = fresh_tracer.events()
+    main_tid = next(e["tid"] for e in events if e["name"] == "in_main")
+    thread_tid = next(e["tid"] for e in events if e["name"] == "in_thread")
+    assert main_tid != thread_tid
+    names = {
+        e["args"]["name"] for e in events if e.get("ph") == "M"
+    }
+    assert "test-worker" in names
+
+
+def test_tracer_ring_buffer_bounds_events():
+    t = tracer.SpanTracer(capacity=10)
+    for i in range(50):
+        with t.span(f"s{i}"):
+            pass
+    events = [e for e in t.events() if e.get("ph") == "X"]
+    assert len(events) == 10
+    assert events[-1]["name"] == "s49"  # the ring keeps the most recent tail
+
+
+def test_span_is_shared_noop_when_disabled():
+    assert tracer.get_tracer() is None
+    before = counters.get("trace_spans")
+    s1 = tracer.span("anything", "x", a=1)
+    s2 = tracer.span("else")
+    assert s1 is s2  # one shared no-op object: no allocation per call
+    with s1:
+        pass
+    tracer.instant("nothing")
+    assert counters.get("trace_spans") == before
+
+
+def test_manual_complete_spans(fresh_tracer):
+    t0 = fresh_tracer.now_us()
+    fresh_tracer.complete("manual", t0, 123.0, "test", block=2)
+    e = [x for x in fresh_tracer.events() if x["name"] == "manual"][0]
+    assert e["dur"] == 123.0 and e["args"] == {"block": 2}
+
+
+# ---------------------------------------------------------------------------
+# registry + status surfacing
+# ---------------------------------------------------------------------------
+
+
+def test_registry_increment_snapshot_delta_threadsafe():
+    from evotorch_tpu.observability import CounterRegistry
+
+    reg = CounterRegistry()
+    snap = reg.snapshot(("a", "b"))
+
+    def bump():
+        for _ in range(1000):
+            reg.increment("a")
+
+    threads = [threading.Thread(target=bump) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    reg.increment("b", 5)
+    assert reg.delta(snap) == {"a": 4000, "b": 5}
+    assert reg.get("missing") == 0
+
+
+def test_searcher_status_carries_registry_deltas_and_eval_telemetry():
+    from evotorch_tpu.algorithms import PGPE
+    from evotorch_tpu.neuroevolution import VecNE
+
+    problem = VecNE(
+        CartPole(),
+        "Linear(obs_length, act_length)",
+        episode_length=EPISODE_LENGTH,
+        eval_mode="episodes_refill",
+        refill_config={"width": 4},
+        seed=0,
+    )
+    searcher = PGPE(
+        problem,
+        popsize=POPSIZE,
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        stdev_init=0.1,
+    )
+    searcher.step()
+    status = dict(searcher.status.items())
+    # registry deltas are status keys from the very first step
+    assert status["compiles"] >= 1  # warmup generation compiled
+    assert "trace_spans" in status and "telemetry_fetches" in status
+    searcher.step()
+    searcher.step()
+    status = dict(searcher.status.items())
+    # eval telemetry lags one generation (device-scalar discipline) — by
+    # step 3 it reports the refill contract's figures
+    assert 0.0 < status["eval_occupancy"] <= 1.0
+    assert status["eval_refill_events"] == POPSIZE - 4
+    assert status["eval_queue_wait"] >= 0
+    # steady state: nothing recompiles once warm
+    assert status["compiles"] == 0
+
+
+def test_host_pipeline_reports_occupancy():
+    from evotorch_tpu.neuroevolution.net.hostvecenv import (
+        SyncVectorEnv,
+        run_host_pipelined_rollout,
+    )
+
+    gym = pytest.importorskip("gymnasium")
+
+    class ToyEnv:
+        def __init__(self, horizon=6):
+            self.h = horizon
+            self.t = 0
+            self.observation_space = gym.spaces.Box(-1, 1, (3,))
+            self.action_space = gym.spaces.Box(-1, 1, (2,))
+
+        def reset(self, seed=None):
+            self.t = 0
+            return np.zeros(3, np.float32), {}
+
+        def step(self, action):
+            self.t += 1
+            return np.zeros(3, np.float32), 1.0, self.t >= self.h, False, {}
+
+    policy = FlatParamsPolicy(Linear(3, 2) >> Tanh())
+    vec = SyncVectorEnv(lambda: ToyEnv(), 4)
+    params = jnp.asarray(
+        np.random.default_rng(0).normal(size=(8, policy.parameter_count)),
+        jnp.float32,
+    )
+    result = run_host_pipelined_rollout(
+        vec, policy, params, num_episodes=1, episode_length=10, mode="sync"
+    )
+    # equal-length toy episodes + work-conserving refill: every executed
+    # lane-step is counted
+    assert result["occupancy"] == 1.0
+    assert result["interactions"] == 8 * 6
