@@ -28,6 +28,16 @@ sentinel count over every timed loop — anything but 0 is a retrace bug).
 ``BENCH_TELEMETRY=0`` compiles the accumulator-free programs (the overhead
 A/B baseline).
 
+The program LEDGER (docs/observability.md "Program ledger") adds, per
+contract and hoisted top-level for the primary one: ``compile_seconds``
+(AOT compile wall-time of the contract's program), ``flops_per_step``
+(cost-model FLOPs per counted env-step), ``peak_hbm_bytes`` (analyzed peak
+footprint — donation-aware, a dropped ``donate_argnums`` inflates it) and
+``model_efficiency`` (achieved FLOP rate vs the nominal per-backend peak;
+``EVOTORCH_PEAK_FLOPS`` overrides). ``BENCH_LEDGER=0`` skips the capture
+(one extra untimed trace+compile per contract) and keeps the line
+byte-compatible with pre-ledger rounds.
+
 ``BENCH_BACKEND=mujoco`` additionally measures the REAL-MuJoCo host path
 (``MjVecEnv`` over ``mujoco.rollout``): the PR-2 synchronous fixed-chunk loop
 vs the Sebulba-style pipelined refill scheduler, reported as
@@ -49,6 +59,7 @@ from bench_common import (
     build_policy,
     compact_kwargs,
     fresh_pgpe_state,
+    ledger_columns,
     measure_mujoco,
     refill_kwargs,
     setup_backend,
@@ -76,6 +87,9 @@ def main():
         run_vectorized_rollout_compacting,
     )
     from evotorch_tpu.observability import EvalTelemetry
+    from evotorch_tpu.observability import ledger as program_ledger
+    from evotorch_tpu.observability.inventory import capture_compact_chunk
+    from evotorch_tpu.observability.programs import abstract_like
 
     cfg = bench_config(use_cpu)
     popsize = cfg["popsize"]
@@ -183,12 +197,42 @@ def main():
             ),
             file=sys.stderr,
         )
+        # program ledger (BENCH_LEDGER=1, the default): AOT-capture the
+        # contract's compiled program — compile wall-time, cost-model FLOPs,
+        # analyzed peak memory, donation verification — OUTSIDE every timed
+        # region (lowering on ShapeDtypeStructs, so the donated state is
+        # never consumed; costs one extra trace+compile per contract)
+        record = None
+        if cfg["ledger"]:
+            shape = {
+                "env": cfg["env_name"],
+                "popsize": popsize,
+                "episode_length": episode_length,
+            }
+            if mode == "episodes_compact":
+                record = capture_compact_chunk(
+                    program_ledger, env, policy, popsize, episode_length,
+                    chunk_size=ckw["chunk_size"],
+                    compute_dtype=compute_dtype,
+                    telemetry=cfg["telemetry"],
+                    name="bench.compact_chunk",
+                    shape=dict(shape, chunk=ckw["chunk_size"]),
+                )
+            else:
+                record = program_ledger.capture(
+                    f"bench.generation[{mode}]",
+                    gen,
+                    abstract_like(fresh_pgpe_state(policy.parameter_count)),
+                    jax.random.key(0),
+                    shape=shape,
+                )
         return (
             total_steps / elapsed,
             generations / elapsed,
             key,
             decoded,
             compile_log.count,
+            record,
         )
 
     key = jax.random.key(0)
@@ -206,7 +250,9 @@ def main():
     telemetry_by_mode = {}
     steady_compiles = 0
     for mode in all_modes:
-        sps, gps, key, mode_telemetry, mode_compiles = measure_mode(mode, key)
+        sps, gps, key, mode_telemetry, mode_compiles, record = measure_mode(
+            mode, key
+        )
         telemetry_by_mode[mode] = mode_telemetry
         steady_compiles += mode_compiles
         modes[mode] = {
@@ -216,6 +262,27 @@ def main():
         }
         if mode_telemetry is not None:
             modes[mode]["occupancy"] = round(mode_telemetry.occupancy, 4)
+        if record is not None:
+            # the compact record covers ONE full-width chunk, not a whole
+            # generation: its per-step denominator is the chunk's executed
+            # lane-step slots (docs/observability.md "Program ledger")
+            if mode == "episodes_compact":
+                steps_per_gen = cfg["compact_chunk"] * popsize
+                modes[mode].update(
+                    ledger_columns(
+                        record,
+                        steps_per_sec=sps,
+                        steps_per_generation=steps_per_gen,
+                    )
+                )
+            else:
+                modes[mode].update(
+                    ledger_columns(
+                        record,
+                        steps_per_sec=sps,
+                        steps_per_generation=(sps / gps if gps else None),
+                    )
+                )
 
     primary = modes[eval_mode]
     # the episodes-contract headline is the best runner of that contract
@@ -266,6 +333,17 @@ def main():
         "compute_dtype": str(compute_dtype.__name__ if compute_dtype else "float32"),
         "backend": "cpu-fallback" if use_cpu else "tpu",
     }
+    if cfg["ledger"]:
+        # the primary contract's program-ledger figures, hoisted next to
+        # `value` (per-contract copies live inside `modes`); absent entirely
+        # under BENCH_LEDGER=0 so the line stays byte-compatible
+        for column in (
+            "compile_seconds",
+            "flops_per_step",
+            "peak_hbm_bytes",
+            "model_efficiency",
+        ):
+            line[column] = primary.get(column)
     if cfg["mj_backend"]:
         # BENCH_BACKEND=mujoco: append the real-MuJoCo host-path columns
         # (sync chunked loop vs pipelined refill scheduler over MjVecEnv);

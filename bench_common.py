@@ -63,6 +63,12 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
         # the A/B baseline proving the zero-sync telemetry costs nothing
         # (docs/observability.md); default on
         "telemetry": os.environ.get("BENCH_TELEMETRY", "1") != "0",
+        # BENCH_LEDGER=0 skips the program-ledger capture (one extra AOT
+        # trace+compile per contract, outside every timed region) and with
+        # it the compile_seconds / flops_per_step / peak_hbm_bytes /
+        # model_efficiency columns — the output line is then byte-compatible
+        # with pre-ledger rounds (docs/observability.md "Program ledger")
+        "ledger": os.environ.get("BENCH_LEDGER", "1") != "0",
         # BENCH_LOWRANK=k: evaluate a low-rank-structured population of rank k
         # (the MXU path for wide policies, net/lowrank.py); 0 = dense
         "lowrank": int(os.environ.get("BENCH_LOWRANK", "0")),
@@ -131,16 +137,12 @@ def refill_kwargs(cfg: dict, *, n_shards: int = 1) -> dict:
 
 def _bench_mlp(obs_dim: int, act_dim: int):
     """The BENCH_HIDDEN-sized MLP, shared by every bench policy builder so
-    the bespoke-sim contracts and the real-MuJoCo A/B cannot silently bench
-    different architectures."""
-    from evotorch_tpu.neuroevolution.net import Linear, Tanh
+    the bespoke-sim contracts, the real-MuJoCo A/B and the program ledger's
+    gate programs cannot silently bench different architectures."""
+    from evotorch_tpu.neuroevolution.net import tanh_mlp
 
     hidden = [int(h) for h in os.environ.get("BENCH_HIDDEN", "64,64").split(",") if h]
-    net = Linear(obs_dim, hidden[0])
-    for a, b in zip(hidden, hidden[1:] + [None]):
-        net = net >> Tanh()
-        net = net >> Linear(a, b if b is not None else act_dim)
-    return net
+    return tanh_mlp(obs_dim, act_dim, hidden)
 
 
 def build_policy(env):
@@ -269,6 +271,39 @@ def measure_mujoco(cfg: dict) -> dict:
         "mj_steps_per_sec": round(out["pipelined"]["steps_per_sec"], 1),
         "mj_pipeline_speedup": round(
             out["pipelined"]["steps_per_sec"] / out["sync"]["steps_per_sec"], 3
+        ),
+    }
+
+
+def ledger_columns(record, *, steps_per_sec, steps_per_generation):
+    """The per-contract program-ledger columns bench.py/bench_multichip.py
+    append when BENCH_LEDGER is on. Nullable by design: a backend whose
+    cost/memory analysis is unavailable emits nulls, never crashes
+    (observability.programs guarded accessors).
+
+    ``flops_per_step`` is the cost model's FLOPs per counted env-step;
+    ``model_efficiency`` is the achieved FLOP rate over the nominal
+    per-backend peak (EVOTORCH_PEAK_FLOPS overrides;
+    observability.report.NOMINAL_PEAK_FLOPS documents the defaults)."""
+    import jax
+
+    from evotorch_tpu.observability.report import peak_flops
+
+    flops_per_step = None
+    if record.flops and steps_per_generation:
+        flops_per_step = record.flops / steps_per_generation
+    efficiency = None
+    peak = peak_flops(jax.devices()[0].platform)
+    if flops_per_step is not None and steps_per_sec and peak:
+        efficiency = flops_per_step * steps_per_sec / peak
+    return {
+        "compile_seconds": round(record.compile_seconds, 3),
+        "flops_per_step": (
+            None if flops_per_step is None else round(flops_per_step, 2)
+        ),
+        "peak_hbm_bytes": record.peak_bytes,
+        "model_efficiency": (
+            None if efficiency is None else round(efficiency, 6)
         ),
     }
 

@@ -15,7 +15,11 @@ exercised on the 8-virtual-device CPU mesh
 chip (mesh of 1).
 
 Knobs: the same BENCH_* env vars as bench.py, plus BENCH_MESH (number of
-devices to use; default all).
+devices to use; default all). With BENCH_LEDGER on (default), the sharded
+generation program is AOT-captured into the program ledger and the line
+carries ``compile_seconds`` / ``flops_per_step`` / ``peak_hbm_bytes`` /
+``model_efficiency`` (null for the host-orchestrated episodes_compact
+path, which has no single whole-generation program).
 """
 
 import json
@@ -29,6 +33,7 @@ from bench_common import (
     build_policy,
     compact_kwargs,
     fresh_pgpe_state,
+    ledger_columns,
     refill_kwargs,
     setup_backend,
 )
@@ -178,6 +183,30 @@ def main():
         file=sys.stderr,
     )
 
+    # program ledger (BENCH_LEDGER, like bench.py): AOT-capture the sharded
+    # generation program — compile wall-time, FLOPs, peak memory, donation
+    # verification — outside the timed loop. The compact path is
+    # host-orchestrated (no single whole-generation program), so its ledger
+    # columns stay null.
+    record = None
+    if cfg["ledger"] and eval_mode != "episodes_compact":
+        from evotorch_tpu.observability import ledger as program_ledger
+        from evotorch_tpu.observability.programs import abstract_like
+
+        record = program_ledger.capture(
+            f"bench_multichip.generation[{eval_mode}]",
+            generation,
+            abstract_like(fresh_pgpe_state(policy.parameter_count)),
+            jax.random.key(0),
+            abstract_like(stats),
+            shape={
+                "env": cfg["env_name"],
+                "popsize": popsize,
+                "episode_length": episode_length,
+                "mesh": mesh_size,
+            },
+        )
+
     t0 = time.perf_counter()
     total_steps = 0
     shard_steps = np.zeros(mesh_size, dtype=np.int64)
@@ -190,6 +219,22 @@ def main():
     elapsed = time.perf_counter() - t0
 
     steps_per_sec = total_steps / elapsed
+    ledger_cols = {}
+    if cfg["ledger"]:
+        ledger_cols = (
+            ledger_columns(
+                record,
+                steps_per_sec=steps_per_sec,
+                steps_per_generation=total_steps / generations,
+            )
+            if record is not None
+            else {
+                "compile_seconds": None,
+                "flops_per_step": None,
+                "peak_hbm_bytes": None,
+                "model_efficiency": None,
+            }
+        )
     print(
         f"{generations} generations, {total_steps} env-steps in {elapsed:.2f}s; "
         f"mean score {float(jnp.mean(scores)):.3f}; "
@@ -203,6 +248,7 @@ def main():
                 "value": round(steps_per_sec, 1),
                 "unit": "env_steps/sec",
                 "vs_baseline": round(steps_per_sec / 1_000_000, 4),
+                **ledger_cols,
                 "mesh": {"pop": mesh_size},
                 "per_shard_steps": shard_steps.tolist(),
                 "env": cfg["env_name"],

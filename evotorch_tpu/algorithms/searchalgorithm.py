@@ -15,7 +15,7 @@ from typing import Optional
 import numpy as np
 
 from ..core import Problem
-from ..observability import counters, ensure_compile_counter
+from ..observability import counters, ensure_compile_counter, ensure_compile_timer
 from ..observability.tracer import span
 from ..tools.hook import Hook
 from ..tools.lazyreporter import LazyReporter, LazyStatusDict
@@ -36,9 +36,12 @@ class SearchAlgorithm(LazyReporter):
         super().__init__(**kwargs)
         # session-wide compile accounting (observability.registry): from the
         # first searcher on, every XLA compile in the process increments the
-        # `compiles` counter — step() publishes the per-generation delta, so
-        # a steady-state retrace is visible in every logger for free
+        # `compiles` counter and accumulates its wall time into
+        # `compile_seconds` — step() publishes the per-generation deltas, so
+        # a steady-state retrace is visible (count AND cost) in every logger
+        # for free
         ensure_compile_counter()
+        ensure_compile_timer()
         self._problem = problem
         self._before_step_hook = Hook()
         self._after_step_hook = Hook()
@@ -121,17 +124,22 @@ class SearchAlgorithm(LazyReporter):
         """One generation (reference ``searchalgorithm.py:380-397``).
         Beyond the reference, per-generation wall-clock is published as
         ``step_seconds``, and the observability registry's per-step deltas
-        as ``compiles`` / ``trace_spans`` / ``telemetry_fetches`` — a
-        nonzero ``compiles`` after warmup IS a steady-state retrace
-        (SURVEY.md §5: the reference has no tracing beyond
-        ``first_step_datetime``)."""
+        as ``compiles`` / ``trace_spans`` / ``telemetry_fetches`` /
+        ``compile_seconds`` (compile-pipeline wall time this generation) —
+        a nonzero ``compiles`` after warmup IS a steady-state retrace, and
+        ``compile_seconds`` says what it cost. ``peak_hbm_bytes`` is the
+        program ledger's high-water gauge (the largest analyzed peak
+        footprint captured so far; 0 until something is captured —
+        docs/observability.md "Program ledger")."""
         import time
 
         self._before_step_hook()
         self.clear_status()
         if self._first_step_datetime is None:
             self._first_step_datetime = datetime.now()
-        meters = counters.snapshot(("compiles", "trace_spans", "telemetry_fetches"))
+        meters = counters.snapshot(
+            ("compiles", "trace_spans", "telemetry_fetches", "compile_seconds")
+        )
         t0 = time.perf_counter()
         with span("generation", "algo", n=self._steps_count + 1):
             self._step()
@@ -139,6 +147,9 @@ class SearchAlgorithm(LazyReporter):
         self._steps_count += 1
         self.update_status({"iter": self._steps_count, "step_seconds": step_seconds})
         self.update_status(counters.delta(meters))
+        # absolute gauges (not per-step deltas): the ledger's peak-footprint
+        # high-water mark, so every logger row carries the memory figure
+        self.update_status({"peak_hbm_bytes": counters.get("peak_hbm_bytes")})
         # refresh the lazy problem-status passthrough (see get_status_value)
         self._problem_status_keys = tuple(self._problem.iter_status_keys())
         extra = self._after_step_hook.accumulate_dict()

@@ -420,6 +420,21 @@ class FeedForwardNet(Module):
         return f"FeedForwardNet({self._seq!r})"
 
 
+def tanh_mlp(input_size: int, output_size: int, hidden: Sequence) -> Module:
+    """The ``Linear >> Tanh >> ... >> Linear`` policy stack every benchmark
+    surface shares (bench_common's BENCH_HIDDEN policies, the program
+    ledger's gate-shape programs) — ONE builder, so the architecture the
+    perf gate measures cannot drift from the one bench.py benchmarks."""
+    sizes = [int(h) for h in hidden]
+    if not sizes:
+        return Linear(int(input_size), int(output_size))
+    net = Linear(int(input_size), sizes[0])
+    for a, b in zip(sizes, sizes[1:] + [None]):
+        net = net >> Tanh()
+        net = net >> Linear(a, b if b is not None else int(output_size))
+    return net
+
+
 class StructuredControlNet(Module):
     """Structured Control Net (Srouji, Zhang, Salakhutdinov 2018): the sum of
     a linear module and a nonlinear MLP module

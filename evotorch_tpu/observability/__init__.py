@@ -18,8 +18,15 @@ Three cooperating pieces (docs/observability.md has the full catalog):
 - :mod:`~evotorch_tpu.observability.registry` — a process-wide counter
   registry (``compiles`` via the session-wide promotion of
   ``retrace_sentinel``'s compile counting, ``trace_spans``,
-  ``telemetry_fetches``) surfaced through searcher ``status`` dicts, so
+  ``telemetry_fetches``, ``compile_seconds`` wall time,
+  ``peak_hbm_bytes`` gauge) surfaced through searcher ``status`` dicts, so
   ``StdOutLogger``/``PandasLogger`` pick everything up for free.
+- :mod:`~evotorch_tpu.observability.programs` — the PROGRAM ledger
+  (compile-time sibling of the runtime telemetry above): per
+  (program, shape) XLA cost/memory accounting, runtime-verified
+  ``donate_argnums`` aliasing, and the checked-in perf-regression
+  baseline (``ledger_baseline.json``, gated in the fast tier). Report
+  CLI: ``python -m evotorch_tpu.observability.report``.
 """
 
 from .devicemetrics import (  # noqa: F401
@@ -27,10 +34,24 @@ from .devicemetrics import (  # noqa: F401
     TELEMETRY_WIDTH,
     pack_eval_telemetry,
 )
+from .programs import (  # noqa: F401
+    DonationReport,
+    ProgramLedger,
+    ProgramRecord,
+    compare_to_baseline,
+    default_ledger_baseline_path,
+    guarded_cost_analysis,
+    guarded_memory_analysis,
+    ledger,
+    load_ledger_baseline,
+    save_ledger_baseline,
+    verify_runtime_donation,
+)
 from .registry import (  # noqa: F401
     CounterRegistry,
     counters,
     ensure_compile_counter,
+    ensure_compile_timer,
 )
 from .tracer import (  # noqa: F401
     SpanTracer,
@@ -49,6 +70,18 @@ __all__ = [
     "CounterRegistry",
     "counters",
     "ensure_compile_counter",
+    "ensure_compile_timer",
+    "DonationReport",
+    "ProgramLedger",
+    "ProgramRecord",
+    "compare_to_baseline",
+    "default_ledger_baseline_path",
+    "guarded_cost_analysis",
+    "guarded_memory_analysis",
+    "ledger",
+    "load_ledger_baseline",
+    "save_ledger_baseline",
+    "verify_runtime_donation",
     "SpanTracer",
     "get_tracer",
     "instant",

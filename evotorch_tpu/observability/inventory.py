@@ -1,0 +1,578 @@
+"""The registered program inventory of the stack's jitted entry points.
+
+One place declares *which* compiled programs constitute the framework —
+the four eval-contract rollout programs, the sharded evaluator, the
+gaussian functional ask/tell, the batched functional search, and the
+bench/multichip whole-generation steps — so the program ledger
+(:mod:`~evotorch_tpu.observability.programs`), the report CLI and the
+fast-tier perf-regression gate all see the same surface.
+
+Everything here builds programs at a configurable *gate shape*
+(:class:`GateConfig`, tiny by default so a full capture costs seconds of
+compile on the CPU mesh, not minutes). FLOPs and per-lane memory scale
+~linearly in ``popsize``/``episode_length`` for fixed program structure,
+so a structural regression at the gate shape is a flagship regression too
+— the gate catches it in tier-1 instead of months later in a rare healthy
+TPU window (the flagship-shape snapshot on the real chip is a
+``scripts/tpu_window.sh`` battery step).
+
+Heavy imports stay inside the builders: ``observability`` is imported by
+``algorithms`` at class-definition time, so importing envs/algorithms at
+module scope here would cycle.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .programs import (
+    ProgramLedger,
+    ProgramRecord,
+    abstract_like as _abstract,
+    ledger,
+    program_key,
+)
+
+__all__ = [
+    "GateConfig",
+    "ProgramSpec",
+    "build_specs",
+    "capture_compact_chunk",
+    "capture_inventory",
+    "donated_programs",
+    "inventory_keys",
+]
+
+
+@dataclass(frozen=True)
+class GateConfig:
+    """Shape configuration for an inventory capture. The defaults are the
+    fast-tier gate shapes (checked into ``ledger_baseline.json``); the
+    report CLI's ``--flagship`` swaps in benchmark-scale values."""
+
+    env_name: str = "cartpole"
+    popsize: int = 8
+    episode_length: int = 16
+    hidden: Tuple[int, ...] = (8,)
+    refill_width: int = 4
+    chunk_size: int = 8
+    batched_searches: int = 4
+    batched_dim: int = 8
+    batched_popsize: int = 8
+    batched_generations: int = 3
+
+
+@dataclass(frozen=True)
+class ProgramSpec:
+    """One registered program: a stable (name, shape) identity plus a
+    thunk that captures it into a ledger."""
+
+    name: str
+    shape: Dict[str, Any] = field(compare=False, default_factory=dict)
+    capture: Callable[[ProgramLedger], ProgramRecord] = field(
+        compare=False, default=None
+    )
+
+    @property
+    def key(self) -> str:
+        return program_key(self.name, self.shape)
+
+
+# ---------------------------------------------------------------------------
+# jitted program builders (lru_cached: one wrapper per config, never per call)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=8)
+def _env_policy(env_name: str, hidden: Tuple[int, ...]):
+    """Cached: env/policy identity keys the jitted-program lru_caches below
+    (and vecrl's engine caches), so repeated build_specs/donated_programs
+    calls reuse compiled programs instead of retracing per call."""
+    from ..envs import make_env
+    from ..neuroevolution.net import FlatParamsPolicy, tanh_mlp
+
+    env = make_env(env_name)
+    net = tanh_mlp(env.observation_size, env.action_size, hidden)
+    return env, FlatParamsPolicy(net)
+
+
+def _fresh_pgpe_state(parameter_count: int):
+    import jax.numpy as jnp
+
+    from ..algorithms.functional import pgpe
+
+    return pgpe(
+        center_init=jnp.zeros(parameter_count, dtype=jnp.float32),
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        objective_sense="max",
+        stdev_init=0.1,
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def _gaussian_programs():
+    import jax
+
+    from ..algorithms.functional import pgpe_ask, pgpe_tell
+
+    ask = jax.jit(pgpe_ask, static_argnames=("popsize",))
+    tell = jax.jit(pgpe_tell, donate_argnums=(0,))
+    return ask, tell
+
+
+@functools.lru_cache(maxsize=8)
+def _batched_search_program(num_searches: int, dim: int, popsize: int):
+    """The examples/functional_batched_search.py program shape: N
+    independent CEM searches scanned as ONE jitted, state-donating
+    program (batch dims on the state)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..algorithms.functional import cem_ask, cem_tell
+
+    def _generation(state, key):
+        pop = cem_ask(key, state, popsize=popsize)
+        fit = jnp.sum(pop**2, axis=-1)
+        return cem_tell(state, pop, fit), jnp.min(fit, axis=-1)
+
+    def _run(state, keys):
+        return jax.lax.scan(_generation, state, keys)
+
+    return jax.jit(_run, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=8)
+def _bench_generation_program(env, policy, popsize: int, episode_length: int):
+    """bench.py's monolithic generation: PGPE ask -> budget rollout ->
+    tell, one jitted program donating the optimizer state."""
+    import jax
+
+    from ..algorithms.functional import pgpe_ask, pgpe_tell
+    from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+    def _generation(state, key, stats):
+        k1, k2 = jax.random.split(key)
+        values = pgpe_ask(k1, state, popsize=popsize)
+        result = run_vectorized_rollout(
+            env,
+            policy,
+            values,
+            k2,
+            stats,
+            num_episodes=1,
+            episode_length=episode_length,
+            eval_mode="budget",
+        )
+        new_state = pgpe_tell(state, values, result.scores)
+        return new_state, result.total_steps, result.scores
+
+    return jax.jit(_generation, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=8)
+def _multichip_generation_program(
+    env, policy, mesh_size: int, popsize: int, episode_length: int
+):
+    """bench_multichip.py's generation: the same program shard_mapped over
+    a ("pop",) mesh with psum stat/step merging, state donated."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..algorithms.functional import pgpe_ask, pgpe_tell
+    from ..neuroevolution.net.vecrl import global_lane_ids, run_vectorized_rollout
+
+    mesh = Mesh(np.asarray(jax.devices()[:mesh_size]), axis_names=("pop",))
+    pop_sharding = NamedSharding(mesh, P("pop"))
+
+    def _local_rollout(values_shard, key, stats):
+        ids = global_lane_ids("pop", values_shard.shape[0])
+        result = run_vectorized_rollout(
+            env,
+            policy,
+            values_shard,
+            key,
+            stats,
+            lane_ids=ids,
+            num_episodes=1,
+            episode_length=episode_length,
+            eval_mode="budget",
+        )
+        delta = jax.tree_util.tree_map(
+            lambda new, old: new - old, result.stats, stats
+        )
+        merged = jax.tree_util.tree_map(
+            lambda old, d: old + jax.lax.psum(d, "pop"), stats, delta
+        )
+        return result.scores, merged, result.total_steps[None]
+
+    sharded = jax.shard_map(
+        _local_rollout,
+        mesh=mesh,
+        in_specs=(P("pop"), P(), P()),
+        out_specs=(P("pop"), P(), P("pop")),
+        check_vma=False,
+    )
+
+    def _generation(state, key, stats):
+        k1, k2 = jax.random.split(key)
+        values = pgpe_ask(k1, state, popsize=popsize)
+        values = jax.lax.with_sharding_constraint(values, pop_sharding)
+        scores, stats, per_shard = sharded(values, k2, stats)
+        return pgpe_tell(state, values, scores), stats, per_shard
+
+    return jax.jit(_generation, donate_argnums=(0,))
+
+
+def capture_compact_chunk(
+    led: ProgramLedger,
+    env,
+    policy,
+    popsize: int,
+    episode_length: int,
+    *,
+    chunk_size: int,
+    compute_dtype=None,
+    telemetry: bool = True,
+    name: str = "rollout.episodes_compact.chunk",
+    shape: Optional[Dict[str, Any]] = None,
+) -> ProgramRecord:
+    """Capture the lane-compacting runner's full-width chunk program — the
+    dominant cost of the host-orchestrated ``episodes_compact`` contract
+    (the width-descent runs the SAME program at narrower shapes). Shared
+    by the inventory and bench.py so the two cannot drift."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..neuroevolution.net.runningnorm import RunningNorm
+    from ..neuroevolution.net.vecrl import _compacting_fns
+
+    max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
+    max_t = min(max_t, int(episode_length))
+    hard_cap = max_t + 1
+    init_fn, chunk_fn, _, _ = _compacting_fns(
+        env,
+        policy,
+        1,
+        max_t,
+        hard_cap,
+        False,
+        None,
+        None,
+        None,
+        compute_dtype,
+        collect_telemetry=bool(telemetry),
+    )
+    params = jnp.zeros((popsize, policy.parameter_count), dtype=jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    carry, fwd_params = init_fn(params, jax.random.key(0), stats)
+    return led.capture(
+        name,
+        chunk_fn,
+        _abstract(fwd_params),
+        _abstract(carry),
+        shape=shape,
+        num_steps=int(chunk_size),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the inventory
+# ---------------------------------------------------------------------------
+
+
+def _mesh_size(popsize: int) -> int:
+    """The largest usable ("pop",) mesh for this process: every device when
+    the popsize divides evenly, else the largest divisor of popsize."""
+    import jax
+
+    n = len(jax.devices())
+    while n > 1 and popsize % n != 0:
+        n -= 1
+    return n
+
+
+def build_specs(cfg: Optional[GateConfig] = None) -> List[ProgramSpec]:
+    """The registered program list at ``cfg``'s shapes. Building specs is
+    cheap (host objects only); compiles happen in each spec's capture."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..neuroevolution.net.runningnorm import RunningNorm
+    from ..neuroevolution.net.vecrl import run_vectorized_rollout
+    from ..parallel.evaluate import make_sharded_rollout_evaluator
+
+    cfg = cfg if cfg is not None else GateConfig()
+    env, policy = _env_policy(cfg.env_name, cfg.hidden)
+    L = policy.parameter_count
+    params_sds = jax.ShapeDtypeStruct((cfg.popsize, L), jnp.float32)
+    stats = RunningNorm(env.observation_size).stats
+    base_shape = {
+        "env": cfg.env_name,
+        "popsize": cfg.popsize,
+        "episode_length": cfg.episode_length,
+        "params": L,
+    }
+    specs: List[ProgramSpec] = []
+
+    def add(name, shape, capture):
+        specs.append(ProgramSpec(name=name, shape=shape, capture=capture))
+
+    def rollout_capture(mode, shape, **extra):
+        def _capture(led):
+            return led.capture(
+                f"rollout.{mode}",
+                run_vectorized_rollout,
+                env,
+                policy,
+                params_sds,
+                jax.random.key(0),
+                stats,
+                shape=shape,
+                num_episodes=1,
+                episode_length=cfg.episode_length,
+                eval_mode=mode,
+                **extra,
+            )
+
+        return _capture
+
+    for mode in ("budget", "episodes"):
+        add(f"rollout.{mode}", base_shape, rollout_capture(mode, base_shape))
+    refill_shape = dict(base_shape, width=cfg.refill_width)
+    add(
+        "rollout.episodes_refill",
+        refill_shape,
+        rollout_capture("episodes_refill", refill_shape, refill_width=cfg.refill_width),
+    )
+
+    compact_shape = dict(base_shape, chunk=cfg.chunk_size)
+
+    def compact_capture(led):
+        return capture_compact_chunk(
+            led,
+            env,
+            policy,
+            cfg.popsize,
+            cfg.episode_length,
+            chunk_size=cfg.chunk_size,
+            shape=compact_shape,
+        )
+
+    add("rollout.episodes_compact.chunk", compact_shape, compact_capture)
+
+    mesh_size = _mesh_size(cfg.popsize)
+    sharded_shape = dict(base_shape, mesh=mesh_size)
+
+    def sharded_capture(led):
+        # the SAME mesh the shape metadata records: every popsize keeps a
+        # valid (divisible) pop axis, not just multiples of the device count
+        mesh = Mesh(np.asarray(jax.devices()[:mesh_size]), axis_names=("pop",))
+        evaluator = make_sharded_rollout_evaluator(
+            env,
+            policy,
+            mesh=mesh,
+            num_episodes=1,
+            episode_length=cfg.episode_length,
+            eval_mode="budget",
+        )
+        fn = evaluator.program_builder(False, cfg.popsize)
+        return led.capture(
+            "sharded_evaluator",
+            fn,
+            params_sds,
+            jax.random.key(0),
+            stats,
+            shape=sharded_shape,
+        )
+
+    add("sharded_evaluator", sharded_shape, sharded_capture)
+
+    ask_shape = {"popsize": cfg.popsize, "params": L}
+
+    def ask_capture(led):
+        ask, _ = _gaussian_programs()
+        return led.capture(
+            "gaussian.ask",
+            ask,
+            jax.random.key(0),
+            _abstract(_fresh_pgpe_state(L)),
+            shape=ask_shape,
+            popsize=cfg.popsize,
+        )
+
+    def tell_capture(led):
+        _, tell = _gaussian_programs()
+        return led.capture(
+            "gaussian.tell",
+            tell,
+            _abstract(_fresh_pgpe_state(L)),
+            params_sds,
+            jax.ShapeDtypeStruct((cfg.popsize,), jnp.float32),
+            shape=ask_shape,
+        )
+
+    add("gaussian.ask", ask_shape, ask_capture)
+    add("gaussian.tell", ask_shape, tell_capture)
+
+    batched_shape = {
+        "searches": cfg.batched_searches,
+        "dim": cfg.batched_dim,
+        "popsize": cfg.batched_popsize,
+        "generations": cfg.batched_generations,
+    }
+
+    def batched_capture(led):
+        fn = _batched_search_program(
+            cfg.batched_searches, cfg.batched_dim, cfg.batched_popsize
+        )
+        state, keys = _batched_search_args(cfg)
+        return led.capture(
+            "functional_batched_search",
+            fn,
+            _abstract(state),
+            _abstract(keys),
+            shape=batched_shape,
+        )
+
+    add("functional_batched_search", batched_shape, batched_capture)
+
+    def bench_capture(led):
+        fn = _bench_generation_program(env, policy, cfg.popsize, cfg.episode_length)
+        return led.capture(
+            "bench.generation",
+            fn,
+            _abstract(_fresh_pgpe_state(L)),
+            jax.random.key(0),
+            stats,
+            shape=base_shape,
+        )
+
+    add("bench.generation", base_shape, bench_capture)
+
+    def multichip_capture(led):
+        fn = _multichip_generation_program(
+            env, policy, mesh_size, cfg.popsize, cfg.episode_length
+        )
+        return led.capture(
+            "multichip.generation",
+            fn,
+            _abstract(_fresh_pgpe_state(L)),
+            jax.random.key(0),
+            stats,
+            shape=sharded_shape,
+        )
+
+    add("multichip.generation", sharded_shape, multichip_capture)
+    return specs
+
+
+def _batched_search_args(cfg: GateConfig):
+    import jax
+
+    from ..algorithms.functional import cem
+
+    centers = (
+        jax.random.normal(
+            jax.random.key(0), (cfg.batched_searches, cfg.batched_dim)
+        )
+        * 3.0
+    )
+    state = cem(
+        center_init=centers,
+        parenthood_ratio=0.5,
+        objective_sense="min",
+        stdev_init=2.0,
+        stdev_max_change=0.2,
+    )
+    keys = jax.random.split(jax.random.key(1), cfg.batched_generations)
+    return state, keys
+
+
+def inventory_keys(cfg: Optional[GateConfig] = None) -> List[str]:
+    return [spec.key for spec in build_specs(cfg)]
+
+
+def capture_inventory(
+    cfg: Optional[GateConfig] = None,
+    led: Optional[ProgramLedger] = None,
+    *,
+    strict: bool = True,
+) -> Tuple[List[ProgramRecord], Dict[str, str]]:
+    """Capture every registered program into ``led`` (the process ledger by
+    default). Returns ``(records, errors)``; with ``strict`` (the default)
+    the first capture failure raises instead."""
+    led = led if led is not None else ledger
+    records: List[ProgramRecord] = []
+    errors: Dict[str, str] = {}
+    for spec in build_specs(cfg):
+        try:
+            records.append(spec.capture(led))
+        except Exception as e:  # pragma: no cover - strict re-raises
+            if strict:
+                raise
+            errors[spec.key] = f"{type(e).__name__}: {e}"
+    return records, errors
+
+
+# ---------------------------------------------------------------------------
+# the runtime donation sweep surface
+# ---------------------------------------------------------------------------
+
+
+def donated_programs(cfg: Optional[GateConfig] = None):
+    """``(name, fn, args, donate_argnums)`` for every ``donate_argnums``
+    entry point the repo registers — bench tell, the bench and multichip
+    generation steps, and the batched functional search. Each call builds
+    FRESH concrete arguments (the verification executes the program and
+    consumes the donated buffers). The dynamic complement of graftlint's
+    static ``donation`` checker: these assert XLA *applied* the aliasing."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..neuroevolution.net.runningnorm import RunningNorm
+
+    cfg = cfg if cfg is not None else GateConfig()
+    env, policy = _env_policy(cfg.env_name, cfg.hidden)
+    L = policy.parameter_count
+    stats = RunningNorm(env.observation_size).stats
+    _, tell = _gaussian_programs()
+    mesh_size = _mesh_size(cfg.popsize)
+    values = jnp.zeros((cfg.popsize, L), dtype=jnp.float32)
+    fitnesses = jnp.zeros((cfg.popsize,), dtype=jnp.float32)
+    batched_state, batched_keys = _batched_search_args(cfg)
+    return [
+        (
+            "gaussian.tell",
+            tell,
+            (_fresh_pgpe_state(L), values, fitnesses),
+            (0,),
+        ),
+        (
+            "bench.generation",
+            _bench_generation_program(env, policy, cfg.popsize, cfg.episode_length),
+            (_fresh_pgpe_state(L), jax.random.key(0), stats),
+            (0,),
+        ),
+        (
+            "multichip.generation",
+            _multichip_generation_program(
+                env, policy, mesh_size, cfg.popsize, cfg.episode_length
+            ),
+            (_fresh_pgpe_state(L), jax.random.key(0), stats),
+            (0,),
+        ),
+        (
+            "functional_batched_search",
+            _batched_search_program(
+                cfg.batched_searches, cfg.batched_dim, cfg.batched_popsize
+            ),
+            (batched_state, batched_keys),
+            (0,),
+        ),
+    ]

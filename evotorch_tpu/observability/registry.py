@@ -17,9 +17,20 @@ framework feeds:
   Each fetch is one ~24-byte transfer of an already-materialized program
   output; this counter exists so "zero extra transfers" is auditable.
 
+Beyond the integer counters, the registry carries two program-ledger
+companions (PR 9, :mod:`~evotorch_tpu.observability.programs`):
+
+- ``compile_seconds`` — a FLOAT accumulator of compile-pipeline wall time
+  (trace + MLIR lowering + backend compile), fed by jax's monitoring
+  duration events via :func:`ensure_compile_timer` — the wall-clock twin
+  of the ``compiles`` count.
+- ``peak_hbm_bytes`` — a max-gauge over every ledger-captured program's
+  analyzed peak footprint (:meth:`CounterRegistry.observe_max`).
+
 ``SearchAlgorithm.step`` snapshots the registry around each generation and
 publishes the per-step deltas as status keys (``compiles``, ``trace_spans``,
-``telemetry_fetches``), so every logger sees them for free.
+``telemetry_fetches``, ``compile_seconds``) plus the absolute
+``peak_hbm_bytes`` gauge, so every logger sees them for free.
 """
 
 from __future__ import annotations
@@ -27,21 +38,40 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterable, Optional
 
-__all__ = ["CounterRegistry", "counters", "ensure_compile_counter"]
+__all__ = [
+    "CounterRegistry",
+    "counters",
+    "ensure_compile_counter",
+    "ensure_compile_timer",
+]
 
 
 class CounterRegistry:
-    """Thread-safe, monotonically-increasing named counters."""
+    """Thread-safe named meters: monotonically-increasing counters
+    (:meth:`increment` int, :meth:`accumulate` float) and high-water-mark
+    gauges (:meth:`observe_max`)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {}
+        self._counts: Dict[str, float] = {}
 
     def increment(self, name: str, n: int = 1) -> None:
         with self._lock:
             self._counts[name] = self._counts.get(name, 0) + int(n)
 
-    def get(self, name: str) -> int:
+    def accumulate(self, name: str, value: float) -> None:
+        """Float-valued increment (e.g. seconds); keeps the same snapshot /
+        delta discipline as the integer counters."""
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + float(value)
+
+    def observe_max(self, name: str, value: float) -> None:
+        """High-water-mark gauge: the stored value only ever rises."""
+        with self._lock:
+            if value > self._counts.get(name, 0):
+                self._counts[name] = value
+
+    def get(self, name: str):
         with self._lock:
             return self._counts.get(name, 0)
 
@@ -91,3 +121,35 @@ def ensure_compile_counter() -> None:
 
         _compile_sink = _CompileCounterSink()
         retrace_sentinel.register_sink(_compile_sink)
+
+
+_timer_installed = False
+
+
+def _on_duration_event(event: str, duration: float, **_kwargs) -> None:
+    """jax.monitoring duration listener: accumulate the compile pipeline's
+    wall time (trace + jaxpr->MLIR + backend compile all emit under the
+    ``/jax/core/compile/`` prefix) into ``counters['compile_seconds']``."""
+    if event.startswith("/jax/core/compile/"):
+        counters.accumulate("compile_seconds", duration)
+
+
+def ensure_compile_timer() -> None:
+    """Session-scope compile WALL-TIME accounting — the duration twin of
+    :func:`ensure_compile_counter`: from the first call on, every compile's
+    trace/lower/backend-compile durations accumulate into
+    ``counters['compile_seconds']`` via jax's monitoring events.
+
+    Idempotent; a jax build without the monitoring API degrades to a no-op
+    (the counter just stays 0.0)."""
+    global _timer_installed
+    with _compile_lock:
+        if _timer_installed:
+            return
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(_on_duration_event)
+        except Exception:
+            pass
+        _timer_installed = True

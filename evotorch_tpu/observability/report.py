@@ -1,0 +1,255 @@
+"""Program-ledger report CLI.
+
+``python -m evotorch_tpu.observability.report`` captures the registered
+program inventory (:mod:`~evotorch_tpu.observability.inventory`) and
+prints the per-program accounting table: compile wall-time, cost-model
+FLOPs / bytes accessed, analyzed peak memory, the runtime-verified
+donation map, and — for the rollout contracts — measured env-steps/s next
+to the cost-model ceiling (analytic efficiency).
+
+Modes:
+
+- (default) capture at the fast-tier gate shapes and print the table;
+- ``--flagship`` capture at benchmark scale (Humanoid, BENCH_POPSIZE) —
+  the ``scripts/tpu_window.sh`` battery step runs this on the real chip
+  with ``--json`` so flagship-shape peak HBM + compile seconds are
+  snapshotted whenever the tunnel is healthy;
+- ``--check`` assert the capture against ``ledger_baseline.json``
+  (exit 1 on violations/stale — the CLI form of the tier-1 gate in
+  ``tests/test_program_ledger.py``);
+- ``--write-baseline`` refresh the checked-in baseline (refuses partial
+  captures; run under ``--cpu`` so the values match the pytest mesh).
+
+The cost-model ceiling divides the program's analyzed FLOPs by a nominal
+per-backend peak (override with ``EVOTORCH_PEAK_FLOPS``); efficiency is
+achieved-FLOPs-rate / peak. On backends without cost analysis the derived
+columns degrade to ``-`` instead of failing (the guarded accessors).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+from .inventory import GateConfig, capture_inventory, inventory_keys
+from .programs import (
+    ProgramLedger,
+    compare_to_baseline,
+    load_ledger_baseline,
+    save_ledger_baseline,
+)
+
+#: nominal peak FLOP/s per backend for the analytic-efficiency ceiling —
+#: deliberately round figures (a modern host core's SIMD envelope; a
+#: single-chip TPU's bf16 MXU envelope). Override with EVOTORCH_PEAK_FLOPS
+#: when the real part number is known; the column is a RELATIVE regression
+#: metric, not a datasheet claim.
+NOMINAL_PEAK_FLOPS = {"cpu": 5.0e10, "tpu": 2.0e14, "axon": 2.0e14}
+
+
+def peak_flops(platform: str) -> Optional[float]:
+    override = os.environ.get("EVOTORCH_PEAK_FLOPS")
+    if override:
+        return float(override)
+    return NOMINAL_PEAK_FLOPS.get(platform)
+
+
+def _gate_config(args) -> GateConfig:
+    from dataclasses import replace
+
+    if args.flagship:
+        base = GateConfig(
+            env_name="humanoid",
+            popsize=int(os.environ.get("BENCH_POPSIZE", 10_000)),
+            episode_length=int(os.environ.get("BENCH_EPISODE_LENGTH", 200)),
+            hidden=(64, 64),
+            chunk_size=25,
+        )
+    else:
+        base = GateConfig()
+    overrides = {}
+    if args.env is not None:
+        overrides["env_name"] = args.env
+    if args.popsize is not None:
+        overrides["popsize"] = args.popsize
+    if args.episode_length is not None:
+        overrides["episode_length"] = args.episode_length
+    if args.hidden is not None:
+        overrides["hidden"] = tuple(int(h) for h in args.hidden.split(",") if h)
+    cfg = replace(base, **overrides) if overrides else base
+    if args.flagship:
+        # width derives from the EFFECTIVE popsize (CLI overrides included)
+        # so the refill record's width= label matches the compiled program
+        cfg = replace(cfg, refill_width=max(1, cfg.popsize // 8))
+    return cfg
+
+
+def _measure_rollouts(cfg: GateConfig, generations: int = 2) -> dict:
+    """Measured env-steps/s per monolithic rollout contract at ``cfg``'s
+    shapes (warmup + ``generations`` timed calls; tiny at gate shapes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..neuroevolution.net.runningnorm import RunningNorm
+    from ..neuroevolution.net.vecrl import run_vectorized_rollout
+    from .inventory import _env_policy
+
+    env, policy = _env_policy(cfg.env_name, cfg.hidden)
+    stats = RunningNorm(env.observation_size).stats
+    params = jnp.zeros((cfg.popsize, policy.parameter_count), dtype=jnp.float32)
+    measured = {}
+    for mode, extra in (
+        ("budget", {}),
+        ("episodes", {}),
+        ("episodes_refill", {"refill_width": cfg.refill_width}),
+    ):
+        def once(key):
+            result = run_vectorized_rollout(
+                env, policy, params, key, stats,
+                num_episodes=1, episode_length=cfg.episode_length,
+                eval_mode=mode, **extra,
+            )
+            jax.block_until_ready(result.scores)
+            return int(result.total_steps)
+
+        once(jax.random.key(0))  # warmup: compile outside the clock
+        t0 = time.perf_counter()
+        steps = 0
+        for g in range(generations):
+            steps += once(jax.random.key(g + 1))
+        elapsed = time.perf_counter() - t0
+        measured[f"rollout.{mode}"] = {
+            "steps_per_call": steps / generations,
+            "steps_per_sec": steps / elapsed,
+            "calls_per_sec": generations / elapsed,
+        }
+    return measured
+
+
+def _fmt(value, spec="{:g}") -> str:
+    return "-" if value is None else spec.format(value)
+
+
+def _donation_cell(record) -> str:
+    if record.donation is None or record.donation.verified is None:
+        return "-"
+    if record.donation.verified:
+        return f"ok({len(record.donation.donated)})"
+    return f"DROPPED{list(record.donation.missing)}"
+
+
+def print_table(records, measured, platform_peak) -> None:
+    cols = (
+        f"{'program':58s} {'compile_s':>9s} {'flops':>12s} {'bytes_acc':>12s} "
+        f"{'peak_bytes':>11s} {'donation':>12s} {'steps/s':>11s} {'efficiency':>10s}"
+    )
+    print(cols)
+    print("-" * len(cols))
+    for record in sorted(records, key=lambda r: r.key):
+        meas = measured.get(record.name)
+        steps_per_sec = None if meas is None else meas["steps_per_sec"]
+        efficiency = None
+        if (
+            meas is not None
+            and record.flops is not None
+            and platform_peak is not None
+        ):
+            efficiency = record.flops * meas["calls_per_sec"] / platform_peak
+        print(
+            f"{record.key:58s} {record.compile_seconds:9.3f} "
+            f"{_fmt(record.flops):>12s} {_fmt(record.bytes_accessed):>12s} "
+            f"{_fmt(record.peak_bytes):>11s} {_donation_cell(record):>12s} "
+            f"{_fmt(steps_per_sec, '{:.1f}'):>11s} "
+            f"{_fmt(efficiency, '{:.2%}'):>10s}"
+        )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m evotorch_tpu.observability.report",
+        description="Program-ledger capture: XLA cost/memory accounting, "
+        "donation verification, perf-regression baseline workflow.",
+    )
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the 8-virtual-device CPU backend (use for "
+                        "baseline writes: matches the pytest mesh)")
+    parser.add_argument("--flagship", action="store_true",
+                        help="benchmark-scale shapes (Humanoid, BENCH_POPSIZE)")
+    parser.add_argument("--env", default=None)
+    parser.add_argument("--popsize", type=int, default=None)
+    parser.add_argument("--episode-length", type=int, default=None)
+    parser.add_argument("--hidden", default=None, help="comma list, e.g. 64,64")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one JSON line instead of the table")
+    parser.add_argument("--check", action="store_true",
+                        help="assert against ledger_baseline.json; exit 1 on "
+                        "violations or stale entries")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="refresh ledger_baseline.json (refuses partial runs)")
+    parser.add_argument("--baseline", default=None, help="alternate baseline path")
+    parser.add_argument("--no-measure", action="store_true",
+                        help="skip the timed rollout runs (table loses the "
+                        "steps/s and efficiency columns)")
+    args = parser.parse_args(argv)
+
+    if args.cpu:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    cfg = _gate_config(args)
+    led = ProgramLedger()
+    expected = inventory_keys(cfg)
+    records, errors = capture_inventory(cfg, led, strict=False)
+    for key, err in sorted(errors.items()):
+        print(f"capture failed: {key}: {err}", file=sys.stderr)
+
+    measure = not args.no_measure and not args.flagship
+    measured = _measure_rollouts(cfg) if measure else {}
+    platform = records[0].platform if records else jax.devices()[0].platform
+    if args.json:
+        payload = led.to_json()
+        payload["measured"] = measured
+        payload["peak_flops"] = peak_flops(platform)
+        print(json.dumps(payload))
+    else:
+        print_table(records, measured, peak_flops(platform))
+
+    rc = 0
+    if args.write_baseline:
+        path = save_ledger_baseline(
+            records, args.baseline, expected_keys=expected
+        )
+        print(f"wrote {len(records)} programs to {path}", file=sys.stderr)
+    if args.check:
+        baseline = load_ledger_baseline(args.baseline)
+        base_platform = baseline.get("platform")
+        if base_platform not in (None, platform):
+            print(
+                f"warning: baseline platform {base_platform!r} != "
+                f"this run's {platform!r} — bands may not be comparable",
+                file=sys.stderr,
+            )
+        violations, stale = compare_to_baseline(records, baseline)
+        for message in violations:
+            print(f"VIOLATION: {message}", file=sys.stderr)
+        for message in stale:
+            print(f"STALE: {message}", file=sys.stderr)
+        if violations or stale:
+            rc = 1
+    if errors:
+        rc = max(rc, 2)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

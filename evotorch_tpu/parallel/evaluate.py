@@ -222,4 +222,8 @@ def make_sharded_rollout_evaluator(
         )
         return result, per_shard
 
+    # the jitted (lowrank, popsize) -> shard_map program factory, exposed so
+    # the program ledger can AOT-lower the exact executable the evaluator
+    # dispatches (observability/inventory.py)
+    evaluator.program_builder = build
     return evaluator

@@ -77,6 +77,14 @@ run tune_compact 2400 env BENCH_BF16=1 python scripts/tune_compact.py
 # 4. sharded bench on the single real chip (mesh of 1; exercise the path)
 run bench_multichip 1800 python bench_multichip.py
 
+# 4b. program-ledger snapshot at FLAGSHIP shape on the real chip: compile
+#     wall-time, cost-model FLOPs and analyzed peak HBM of every registered
+#     program (one JSON line; compile-only, no timed rollouts) — the
+#     hardware-ground-truth companion of the CPU-mesh gate baseline
+#     (docs/observability.md "Program ledger")
+run ledger_flagship 2400 python -m evotorch_tpu.observability.report \
+  --flagship --json --no-measure
+
 # 5. learning evidence: HalfCheetah (no alive bonus) 200 gens at popsize 10k,
 #    then Humanoid 100 gens with the velocity term reported separately
 # lr/radius pinned to the r4 values (the runner's defaults now derive from
