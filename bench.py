@@ -38,6 +38,16 @@ footprint — donation-aware, a dropped ``donate_argnums`` inflates it) and
 (one extra untimed trace+compile per contract) and keeps the line
 byte-compatible with pre-ledger rounds.
 
+The refill / compaction schedules resolve through the TUNED-CONFIG cache
+(docs/observability.md "The autotuner"): explicit ``BENCH_REFILL_*`` /
+``BENCH_COMPACT_*`` knobs override, else a cache hit for this
+(env, popsize, episode length/count, params, dtype, machine) applies the autotuner's measured winner, else the
+engine defaults. The line carries ``tuned_config_source``
+(override / cache / fallback; per-contract copies and the effective
+refill width/period inside ``modes``). ``BENCH_TUNED=0`` disables both
+the consult and the new keys — the line is then byte-compatible with
+r9/r10 output.
+
 ``BENCH_BACKEND=mujoco`` additionally measures the REAL-MuJoCo host path
 (``MjVecEnv`` over ``mujoco.rollout``): the PR-2 synchronous fixed-chunk loop
 vs the Sebulba-style pipelined refill scheduler, reported as
@@ -57,12 +67,12 @@ from functools import partial
 from bench_common import (
     bench_config,
     build_policy,
-    compact_kwargs,
     fresh_pgpe_state,
     ledger_columns,
     measure_mujoco,
-    refill_kwargs,
     setup_backend,
+    tuned_compact,
+    tuned_refill,
 )
 
 
@@ -113,6 +123,13 @@ def main():
 
     stats = RunningNorm(env.observation_size).stats
 
+    # the refill / compaction schedules, resolved ONCE with provenance:
+    # explicit BENCH_* knobs override, else (BENCH_TUNED=1, the default) the
+    # autotuner's tuned-config cache for this (env, popsize, episode length/count, params, dtype, machine), else
+    # the engine defaults (docs/observability.md "The autotuner")
+    compact_cfg, compact_src = tuned_compact(cfg, params=policy.parameter_count)
+    refill_cfg, refill_src = tuned_refill(cfg, params=policy.parameter_count)
+
     rollout_kwargs = dict(
         num_episodes=1,
         episode_length=episode_length,
@@ -138,7 +155,7 @@ def main():
             # donate the state like the monolithic modes' jitted generation
             # below: tell is state-in/state-out, so the update runs in place
             tell_jit = jax.jit(tell, donate_argnums=(0,))
-            ckw = compact_kwargs(cfg)
+            ckw = compact_cfg
 
             def gen(state, key, prewarm=False):
                 k1, k2 = jax.random.split(key)
@@ -154,7 +171,7 @@ def main():
             state, steps, scores, telemetry = gen(state, sub, prewarm=True)
             jax.block_until_ready(scores)
         else:
-            extra = refill_kwargs(cfg) if mode == "episodes_refill" else {}
+            extra = refill_cfg if mode == "episodes_refill" else {}
 
             def generation(state, key):
                 k1, k2 = jax.random.split(key)
@@ -267,7 +284,7 @@ def main():
             # generation: its per-step denominator is the chunk's executed
             # lane-step slots (docs/observability.md "Program ledger")
             if mode == "episodes_compact":
-                steps_per_gen = cfg["compact_chunk"] * popsize
+                steps_per_gen = compact_cfg["chunk_size"] * popsize
                 modes[mode].update(
                     ledger_columns(
                         record,
@@ -333,6 +350,24 @@ def main():
         "compute_dtype": str(compute_dtype.__name__ if compute_dtype else "float32"),
         "backend": "cpu-fallback" if use_cpu else "tpu",
     }
+    if cfg["tuned"]:
+        # schedule provenance (absent entirely under BENCH_TUNED=0 so the
+        # line stays byte-compatible with pre-autotuner rounds): the
+        # headline `tuned_config_source` is the refill contract's — the
+        # knob the r8 occupancy readout proved mistuned — with per-contract
+        # sources and the EFFECTIVE refill schedule inside `modes`
+        from evotorch_tpu.neuroevolution.net.vecrl import _default_refill_width
+
+        line["tuned_config_source"] = refill_src
+        modes["episodes_refill"]["tuned_config_source"] = refill_src
+        # the EFFECTIVE schedule: on the fallback branch the engine runs
+        # its work/8 default width, not "null" — the tuned-vs-fallback A/B
+        # needs both lines to say what actually ran
+        modes["episodes_refill"]["refill_width"] = refill_cfg.get(
+            "refill_width", _default_refill_width(popsize)
+        )
+        modes["episodes_refill"]["refill_period"] = refill_cfg.get("refill_period")
+        modes["episodes_compact"]["tuned_config_source"] = compact_src
     if cfg["ledger"]:
         # the primary contract's program-ledger figures, hoisted next to
         # `value` (per-contract copies live inside `modes`); absent entirely

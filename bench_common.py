@@ -42,7 +42,12 @@ def setup_backend() -> bool:
 
 def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
     """Parse the BENCH_* knobs (on the CPU fallback, defaults shrink so the
-    benchmark cannot stall the driver)."""
+    benchmark cannot stall the driver).
+
+    The popsize / episode-length / hidden / env defaults are mirrored by
+    the autotuner CLI (observability/autotune.py:_shape_from_args) — KEEP
+    THEM IN SYNC: tuned-config cache hits require exact shape equality,
+    so a drifted default silently downgrades every lookup to fallback."""
     import jax.numpy as jnp
 
     return {
@@ -78,6 +83,7 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
         # host width-decisions, and the width-menu floor — the knobs to sweep
         # on real hardware (BENCH_NOTES.md)
         "compact_chunk": int(os.environ.get("BENCH_COMPACT_CHUNK", "25")),
+        "compact_chunk_explicit": "BENCH_COMPACT_CHUNK" in os.environ,
         "compact_min_width": (
             int(os.environ["BENCH_COMPACT_MINWIDTH"])
             if "BENCH_COMPACT_MINWIDTH" in os.environ
@@ -93,6 +99,15 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
             else None
         ),
         "refill_period": int(os.environ.get("BENCH_REFILL_PERIOD", "1")),
+        "refill_period_explicit": "BENCH_REFILL_PERIOD" in os.environ,
+        # BENCH_TUNED=0 disables the tuned-config cache consult (and the
+        # tuned_config_source column), keeping the line AND the measured
+        # configs byte-compatible with pre-autotuner rounds. Default on:
+        # with no explicit BENCH_REFILL_*/BENCH_COMPACT_* knobs the refill /
+        # compaction schedules come from observability/tuned_configs.json
+        # when this (env, popsize, machine) was tuned
+        # (docs/observability.md "The autotuner").
+        "tuned": os.environ.get("BENCH_TUNED", "1") != "0",
         # BENCH_BACKEND=mujoco: ALSO measure the real-MuJoCo host path (sync
         # chunked loop vs the pipelined refill scheduler) and append the
         # mj_* columns to the JSON line. Default off: the four bespoke-sim
@@ -115,24 +130,89 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
     }
 
 
-def compact_kwargs(cfg: dict, *, n_shards: int = 1) -> dict:
-    """Lane-compaction runner kwargs from the BENCH knobs — one place for
-    both benches. Width knobs are GLOBAL; pass ``n_shards`` to translate for
-    the per-shard sharded runner."""
-    kwargs = {"chunk_size": cfg["compact_chunk"]}
-    if cfg["compact_min_width"] is not None:
-        kwargs["min_width"] = max(1, cfg["compact_min_width"] // n_shards)
-    return kwargs
+def _use_tuned_cache(cfg: dict, params) -> bool:
+    # BENCH_ENV_ARGS mutates the env without changing its cache label, so a
+    # tuned entry for the plain env would be wrong evidence — skip the
+    # cache; likewise when the caller cannot say which policy size the
+    # schedule would serve (params is part of the cache key)
+    return cfg["tuned"] and not cfg["env_kwargs"] and params is not None
 
 
-def refill_kwargs(cfg: dict, *, n_shards: int = 1) -> dict:
-    """Lane-refill engine kwargs from the BENCH knobs. The width knob is
-    GLOBAL; pass ``n_shards`` to translate (flooring, like the other
-    convenience knobs) for a per-shard sharded rollout."""
-    kwargs = {"refill_period": cfg["refill_period"]}
-    if cfg["refill_width"] is not None:
-        kwargs["refill_width"] = max(1, cfg["refill_width"] // n_shards)
-    return kwargs
+def _tuned_shape(cfg: dict, params) -> dict:
+    from evotorch_tpu.observability.timings import canonical_env_label, dtype_label
+
+    return {
+        "env": canonical_env_label(cfg["env_name"]),
+        "popsize": cfg["popsize"],
+        "episode_length": cfg["episode_length"],
+        "num_episodes": 1,  # every bench contract evaluates one episode
+        "params": params,
+        "dtype": dtype_label(cfg["compute_dtype"]),
+    }
+
+
+def tuned_compact(cfg: dict, *, n_shards: int = 1, params=None):
+    """Lane-compaction runner kwargs + ``tuned_config_source`` provenance:
+    explicit ``BENCH_COMPACT_*`` knobs override; else (``BENCH_TUNED=1``,
+    the default) the tuned-config cache entry for this
+    (env, popsize, params, dtype, machine); else the runner defaults.
+    ``params`` is the bench policy's parameter count (part of the cache
+    key — a schedule tuned for one policy size is not evidence for
+    another). Width knobs are GLOBAL; pass ``n_shards`` to translate for
+    the per-shard runner."""
+    from evotorch_tpu.observability.timings import resolve_knobs
+
+    explicit = {
+        "chunk_size": cfg["compact_chunk"] if cfg["compact_chunk_explicit"] else None,
+        "min_width": cfg["compact_min_width"],
+    }
+    config, source = resolve_knobs(
+        explicit,
+        "compact",
+        _tuned_shape(cfg, params),
+        use_cache=_use_tuned_cache(cfg, params),
+    )
+    kwargs = {"chunk_size": int(config.get("chunk_size", cfg["compact_chunk"]))}
+    if config.get("min_width") is not None:
+        kwargs["min_width"] = max(1, int(config["min_width"]) // n_shards)
+    return kwargs, source
+
+
+def compact_kwargs(cfg: dict, *, n_shards: int = 1, params=None) -> dict:
+    """The kwargs half of :func:`tuned_compact` (kept for callers that
+    don't report provenance)."""
+    return tuned_compact(cfg, n_shards=n_shards, params=params)[0]
+
+
+def tuned_refill(cfg: dict, *, n_shards: int = 1, params=None):
+    """Lane-refill engine kwargs + ``tuned_config_source`` provenance —
+    same precedence and cache key as :func:`tuned_compact`. The width
+    knob is GLOBAL; pass ``n_shards`` to translate (flooring, like the
+    other convenience knobs) for a per-shard sharded rollout."""
+    from evotorch_tpu.observability.timings import resolve_knobs
+
+    explicit = {
+        "width": cfg["refill_width"],
+        "period": cfg["refill_period"] if cfg["refill_period_explicit"] else None,
+    }
+    config, source = resolve_knobs(
+        explicit,
+        "refill",
+        _tuned_shape(cfg, params),
+        use_cache=_use_tuned_cache(cfg, params),
+    )
+    kwargs = {
+        "refill_period": int(config.get("period") or cfg["refill_period"])
+    }
+    if config.get("width") is not None:
+        kwargs["refill_width"] = max(1, int(config["width"]) // n_shards)
+    return kwargs, source
+
+
+def refill_kwargs(cfg: dict, *, n_shards: int = 1, params=None) -> dict:
+    """The kwargs half of :func:`tuned_refill` (kept for callers that
+    don't report provenance)."""
+    return tuned_refill(cfg, n_shards=n_shards, params=params)[0]
 
 
 def _bench_mlp(obs_dim: int, act_dim: int):
@@ -216,6 +296,9 @@ def measure_mujoco(cfg: dict) -> dict:
             episode_length=episode_length,
             mode="pipelined",
             num_blocks=num_blocks,
+            # honor BENCH_TUNED=0 at this layer too: with it the measured
+            # mj_* configs stay byte-compatible with pre-autotuner rounds
+            use_tuned_cache=cfg["tuned"],
         )
         return result["interactions"]
 
@@ -240,6 +323,7 @@ def measure_mujoco(cfg: dict) -> dict:
         episode_length=3,
         mode="pipelined",
         num_blocks=num_blocks,
+        use_tuned_cache=cfg["tuned"],
     )
     vec.close()
 

@@ -88,7 +88,10 @@ def main():
     # per-shard refill queues: the width knob is global, the seed stride is
     # the global popsize (unique (solution, episode) seeds across shards)
     rkw = (
-        dict(refill_kwargs(cfg, n_shards=mesh_size), seed_stride=popsize)
+        dict(
+            refill_kwargs(cfg, n_shards=mesh_size, params=policy.parameter_count),
+            seed_stride=popsize,
+        )
         if eval_mode == "episodes_refill"
         else {}
     )
@@ -142,7 +145,7 @@ def main():
         tell_jit = jax.jit(pgpe_tell, donate_argnums=(0,))
 
         first_gen = [True]
-        ckw = compact_kwargs(cfg, n_shards=mesh_size)
+        ckw = compact_kwargs(cfg, n_shards=mesh_size, params=policy.parameter_count)
 
         def generation(state, key, stats):
             k1, k2 = jax.random.split(key)

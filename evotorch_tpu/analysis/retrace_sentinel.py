@@ -68,9 +68,14 @@ class RetraceError(AssertionError):
     budget — a steady-state retrace."""
 
 
-@dataclass
+@dataclass(eq=False)
 class CompileLog:
-    """Names of the programs compiled while tracking was active."""
+    """Names of the programs compiled while tracking was active.
+
+    ``eq=False``: logs are registry entries, and registry membership is by
+    IDENTITY — value equality (two logs that happened to observe the same
+    records) once made ``unregister_sink`` remove the wrong sink (see its
+    docstring)."""
 
     names: List[str] = field(default_factory=list)
 
@@ -145,11 +150,16 @@ def register_sink(sink) -> None:
 
 
 def unregister_sink(sink) -> None:
+    """Remove a sink by IDENTITY, never equality: ``list.remove`` removes
+    the first ``==`` element, and two value-equal sinks (e.g. nested
+    ``CompileLog``s that observed the same records — the common case for
+    overlapping blocks) would make one block's exit silently unregister
+    the OTHER block's sink, which then misses every later compile."""
     with _LOCK:
-        try:
-            _SINKS.remove(sink)
-        except ValueError:
-            pass
+        for i, registered in enumerate(_SINKS):
+            if registered is sink:
+                del _SINKS[i]
+                return
 
 
 def _push_quiet() -> None:

@@ -118,6 +118,14 @@ class GymNE(NEProblem):
             None if host_pipeline_blocks is None else int(host_pipeline_blocks)
         )
         self._mj_nthread = None if mj_nthread is None else int(mj_nthread)
+        # host-knob tuning (observability/timings.py): with no explicit
+        # host_pipeline_blocks / mj_nthread (and no EVOTORCH_MJ_NTHREAD env
+        # override), eval setup consults the machine-scoped "host_pipeline"
+        # entry of the tuned-config cache — the autotuner's measured block
+        # split / thread-pool width for THIS box — before falling back to
+        # the built-in heuristics. Provenance lands in the
+        # `tuned_config_source` status key.
+        self._tuned_host = None
         self._vec_env = None
 
         self._make_gym_env()  # early, so network constants are available
@@ -179,10 +187,36 @@ class GymNE(NEProblem):
         return self._observation_normalization
 
     def _report_counters(self, batch) -> dict:
-        return {
+        status = {
             "total_interaction_count": self._interaction_count,
             "total_episode_count": self._episode_count,
         }
+        if self._tuned_host is not None:
+            status["tuned_config_source"] = self._tuned_host[1]
+        return status
+
+    def _resolve_host_tuning(self) -> dict:
+        """The host-path knobs, resolved once with the shared precedence
+        rule (``observability.timings.resolve_knobs``): any explicit ctor
+        knob — or the ``EVOTORCH_MJ_NTHREAD`` env override — wins for the
+        whole group; else the machine-scoped ``"host_pipeline"`` cache
+        entry; else ``{}`` (the scheduler / MjVecEnv heuristics)."""
+        if self._tuned_host is None:
+            import os
+
+            from ..observability.timings import resolve_knobs
+
+            env_nthread = os.environ.get("EVOTORCH_MJ_NTHREAD", "")
+            explicit = {
+                "num_blocks": self._host_pipeline_blocks,
+                "mj_nthread": (
+                    self._mj_nthread
+                    if self._mj_nthread is not None
+                    else (int(env_nthread) if env_nthread else None)
+                ),
+            }
+            self._tuned_host = resolve_knobs(explicit, "host_pipeline", {})
+        return self._tuned_host[0]
 
     # ------------------------------------------------------------- rollouts
     def _normalize_observation(self, obs, *, update_stats: bool = True) -> np.ndarray:
@@ -249,6 +283,9 @@ class GymNE(NEProblem):
     def _make_vector_env(self):
         if self._vec_env is not None:
             return self._vec_env
+        # explicit mj_nthread / EVOTORCH_MJ_NTHREAD, else the tuned cache's
+        # machine entry, else None (MjVecEnv's saturate-the-machine default)
+        nthread = self._resolve_host_tuning().get("mj_nthread")
         backend = self._vector_env_backend
         if backend in ("auto", "mujoco"):
             try:
@@ -257,11 +294,11 @@ class GymNE(NEProblem):
 
                 if backend == "mujoco":
                     self._vec_env = MjVecEnv(
-                        self._build_one_env, self._num_envs, nthread=self._mj_nthread
+                        self._build_one_env, self._num_envs, nthread=nthread
                     )
                 else:
                     self._vec_env = make_host_vector_env(
-                        self._build_one_env, self._num_envs, nthread=self._mj_nthread
+                        self._build_one_env, self._num_envs, nthread=nthread
                     )
                 return self._vec_env
             except ImportError:
@@ -313,7 +350,13 @@ class GymNE(NEProblem):
                 self._policy,
                 values,
                 mode=self._host_pipeline,
-                num_blocks=self._host_pipeline_blocks,
+                num_blocks=self._resolve_host_tuning().get("num_blocks"),
+                # the group was resolved HERE (explicit > cache > fallback,
+                # one rule for blocks AND nthread together) — the scheduler
+                # must not re-consult the cache at its own altitude, and the
+                # result dict carries THIS resolution's provenance
+                use_tuned_cache=False,
+                tuned_config_source=self._tuned_host[1],
                 **common,
             )
         except HungPhysicsWorkerError:

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..core import SolutionBatch
 from ..envs import Env, make_env
+from ..observability.timings import canonical_env_label, resolve_knobs
 from ..tools.lowrank import LowRankParamsBatch
 from ..parallel.mesh import default_mesh
 from .neproblem import NEProblem
@@ -130,6 +131,22 @@ class VecNE(NEProblem):
             if unknown:
                 raise ValueError(f"Unknown refill_config keys: {sorted(unknown)}")
         self._refill_config = dict(refill_config or {})
+        # tuned-config cache wiring (observability/timings.py): when the
+        # refill / compaction knobs are NOT passed explicitly, eval setup
+        # consults the checked-in tuned_configs.json for this
+        # (env, popsize, episode length/count, params, dtype, machine) key — the autotuner's
+        # measured winners — and falls back to the engines' built-in
+        # defaults on a miss. Explicit knobs always win; the branch taken
+        # is published as the `tuned_config_source` status key
+        # (override / cache / fallback). An env_config-modified env is NOT
+        # the env its cache label names (different dynamics, different
+        # episode-length distribution), so the cache is skipped for it —
+        # a pre-built Env instance with custom ctor args has the same
+        # caveat, which the label cannot detect.
+        self._env_label = canonical_env_label(env)
+        self._tuned_cacheable = not (isinstance(env, str) and env_config)
+        self._tuned_resolution: dict = {}
+        self._tuned_config_source: Optional[str] = None
         if obs_norm_sync not in ("cohort", "step"):
             raise ValueError(
                 f"obs_norm_sync must be 'cohort' or 'step', got {obs_norm_sync!r}"
@@ -209,10 +226,48 @@ class VecNE(NEProblem):
         self._compact_prewarmed_sizes.add(popsize)
         return True
 
-    def _sharded_compact_config(self, n_shards: int) -> dict:
-        """The per-shard form of the (global-width) compact_config: widths
+    def _tuned_knobs(self, group: str, explicit: dict, popsize: int) -> dict:
+        """One knob group resolved at eval-setup time with the shared
+        precedence rule (``observability.timings.resolve_knobs``):
+        explicit config > tuned-config cache hit for this
+        (env, popsize, episode length/count, params, dtype, machine) > the engine's built-in
+        default. Memoized per (group, popsize); the provenance of the
+        LAST resolution is what ``tuned_config_source`` reports (shapes
+        are identical generation to generation, so it is stable in steady
+        state)."""
+        from ..observability.timings import dtype_label
+
+        memo_key = (group, popsize)
+        if memo_key not in self._tuned_resolution:
+            shape = {
+                "env": self._env_label,
+                "popsize": popsize,
+                # the FULL workload identity is the key: episode
+                # length/count set the work-list size and refill
+                # frequency; the policy's parameter count + compute dtype
+                # set the per-step FLOPs/HBM balance — a schedule tuned
+                # for one is not evidence for another
+                "episode_length": self._episode_length,
+                "num_episodes": self._num_episodes,
+                "params": self._policy.parameter_count,
+                "dtype": dtype_label(self._compute_dtype),
+            }
+            self._tuned_resolution[memo_key] = resolve_knobs(
+                explicit, group, shape, use_cache=self._tuned_cacheable
+            )
+        config, source = self._tuned_resolution[memo_key]
+        self._tuned_config_source = source
+        return config
+
+    def _compact_kwargs(self, popsize: int) -> dict:
+        """The lane-compacting runner's kwargs: explicit compact_config,
+        else the tuned cache's (chunk_size, min_width) for this shape."""
+        return dict(self._tuned_knobs("compact", self._compact_config, popsize))
+
+    def _sharded_compact_config(self, n_shards: int, popsize: int) -> dict:
+        """The per-shard form of the (global-width) compact config: widths
         divide by the shard count; chunk_size passes through."""
-        cfg = dict(self._compact_config)
+        cfg = self._compact_kwargs(popsize)
         if cfg.get("min_width") is not None:
             cfg["min_width"] = max(1, int(cfg["min_width"]) // n_shards)
         if cfg.get("allowed_widths") is not None:
@@ -220,16 +275,18 @@ class VecNE(NEProblem):
             cfg["allowed_widths"] = tuple(per_shard)
         return cfg
 
-    def _refill_kwargs(self, n_shards: int = 1) -> dict:
-        """Rollout-engine kwargs of the refill scheduler: the (global) lane
-        width divides by the shard count, like compact_config's widths —
+    def _refill_kwargs(self, popsize: int, n_shards: int = 1) -> dict:
+        """Rollout-engine kwargs of the refill scheduler — explicit
+        refill_config, else the tuned cache. The (global) lane width
+        divides by the shard count, like compact_config's widths —
         flooring, by convention of the convenience knobs (the strict form,
         ``parallel.make_sharded_rollout_evaluator``, raises instead)."""
+        cfg = self._tuned_knobs("refill", self._refill_config, popsize)
         kw = {}
-        if self._refill_config.get("width") is not None:
-            kw["refill_width"] = max(1, int(self._refill_config["width"]) // n_shards)
-        if self._refill_config.get("period") is not None:
-            kw["refill_period"] = int(self._refill_config["period"])
+        if cfg.get("width") is not None:
+            kw["refill_width"] = max(1, int(cfg["width"]) // n_shards)
+        if cfg.get("period") is not None:
+            kw["refill_period"] = int(cfg["period"])
         return kw
 
     def _bump_counters(self, steps, episodes):
@@ -261,6 +318,11 @@ class VecNE(NEProblem):
             # previous generation's figures (lag-by-one; shapes are identical
             # generation to generation, so the diagnostics are current)
             status.update(self._last_telemetry.as_status(prefix="eval_"))
+        if self._tuned_config_source is not None:
+            # where the schedule knobs came from: "override" (explicit
+            # config), "cache" (tuned_configs.json hit) or "fallback"
+            # (engine default) — set on the tunable eval modes only
+            status["tuned_config_source"] = self._tuned_config_source
         return status
 
     # ------------------------------------------------------------ evaluation
@@ -278,10 +340,10 @@ class VecNE(NEProblem):
             return run_vectorized_rollout_compacting(
                 self._env, self._policy, values, key, self._obs_norm.stats,
                 prewarm=self._take_prewarm(_params_popsize(values)),
-                **self._compact_config, **kwargs,
+                **self._compact_kwargs(_params_popsize(values)), **kwargs,
             )
         if self._eval_mode == "episodes_refill":
-            kwargs.update(self._refill_kwargs())
+            kwargs.update(self._refill_kwargs(_params_popsize(values)))
         return run_vectorized_rollout(
             self._env,
             self._policy,
@@ -448,7 +510,7 @@ class VecNE(NEProblem):
                 compute_dtype=self._compute_dtype,
                 prewarm=self._take_prewarm(n),
                 stats_sync=(obsnorm and self._obs_norm_sync == "step"),
-                **self._sharded_compact_config(n_shards),
+                **self._sharded_compact_config(n_shards, n),
             )
             if obsnorm:
                 self._obs_norm.stats = result.stats
@@ -465,7 +527,7 @@ class VecNE(NEProblem):
             # (solution, episode) seed unique across shards, so the sharded
             # evaluation reproduces the unsharded one (bit-for-bit without
             # observation normalization)
-            refill_kwargs = dict(self._refill_kwargs(n_shards), seed_stride=n)
+            refill_kwargs = dict(self._refill_kwargs(n, n_shards), seed_stride=n)
 
         step_sync = obsnorm and self._obs_norm_sync == "step"
 

@@ -27,6 +27,14 @@ Three cooperating pieces (docs/observability.md has the full catalog):
   ``donate_argnums`` aliasing, and the checked-in perf-regression
   baseline (``ledger_baseline.json``, gated in the fast tier). Report
   CLI: ``python -m evotorch_tpu.observability.report``.
+- :mod:`~evotorch_tpu.observability.timings` — the MEASURED-timing
+  ledger (runtime sibling of the program ledger: median steps/s,
+  occupancy, compile seconds per (program, shape, machine) key) and the
+  persisted tuned-config cache (``tuned_configs.json``) the eval stack
+  consults at setup time — explicit knobs always override; every
+  consumer reports ``tuned_config_source`` provenance. Filled by the
+  autotuner: ``python -m evotorch_tpu.observability.autotune``
+  (:mod:`~evotorch_tpu.observability.autotune`).
 """
 
 from .devicemetrics import (  # noqa: F401
@@ -52,6 +60,23 @@ from .registry import (  # noqa: F401
     counters,
     ensure_compile_counter,
     ensure_compile_timer,
+)
+from .timings import (  # noqa: F401
+    SOURCE_CACHE,
+    SOURCE_FALLBACK,
+    SOURCE_OVERRIDE,
+    TimingLedger,
+    TimingRecord,
+    TunedEntry,
+    canonical_env_label,
+    default_tuned_cache_path,
+    load_tuned_cache,
+    lookup_tuned,
+    machine_fingerprint,
+    resolve_knobs,
+    save_tuned_entry,
+    timing_key,
+    timings,
 )
 from .tracer import (  # noqa: F401
     SpanTracer,
@@ -89,4 +114,19 @@ __all__ = [
     "start_tracing",
     "stop_tracing",
     "tracing_enabled",
+    "SOURCE_CACHE",
+    "SOURCE_FALLBACK",
+    "SOURCE_OVERRIDE",
+    "TimingLedger",
+    "TimingRecord",
+    "TunedEntry",
+    "canonical_env_label",
+    "default_tuned_cache_path",
+    "load_tuned_cache",
+    "lookup_tuned",
+    "machine_fingerprint",
+    "resolve_knobs",
+    "save_tuned_entry",
+    "timing_key",
+    "timings",
 ]

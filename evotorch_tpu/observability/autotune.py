@@ -1,0 +1,1462 @@
+"""Occupancy-driven autotuner: close the loop from telemetry to knobs.
+
+PR 8's very first occupancy readout proved the default refill width
+mistuned on this box (BENCH_NOTES.md r8: width 128 → occupancy 0.97,
+refill_speedup 1.72x, vs 0.83 at the work/8 default); this module is the
+loop-closer (ROADMAP item 2, the Podracer discipline of arXiv:2104.06272):
+**measured device utilization, not guesses, picks the schedule.**
+
+The loop::
+
+    on-device counters ──► trial harness ──► measured-timing ledger
+       (PR 8: occupancy,     (interleaved      (timings.TimingLedger:
+        queue_wait,           medians of ≥3)    steps/s + occupancy +
+        refill_events)             │            compile_s per machine key)
+                                   │                      │
+    program ledger ──► analytic pruning            winner persisted
+       (PR 9: peak-HBM /   (reject before                 │
+        FLOPs bounds)       ever timing)                  ▼
+                                            tuned_configs.json ──► consumers
+                                              (checked in)    VecNE · GymNE ·
+                                                               hostvecenv ·
+                                                               parallel.evaluate
+                                                               · bench.py
+
+Three layers:
+
+- **The pure search core** — :func:`candidate_grid`,
+  :func:`neighborhood`, :func:`analytic_prune`,
+  :func:`successive_halving`, :func:`autotune_search`. Deterministic,
+  zero wall-clock, no jax: unit-testable against a synthetic measurement
+  function (tier-1 does exactly that). Selection is always on **medians**
+  (this box times ±20% run to run — CLAUDE.md), with an occupancy floor
+  on the winner (a config that starves lanes does not win on a lucky
+  run).
+- **The trial harnesses** — :class:`RefillHarness` /
+  :class:`CompactHarness` (the bespoke-sim device knobs) and
+  :class:`HostPipelineHarness` (the host-path knobs). Candidates are
+  interleaved in ONE process; every timed call runs under the retrace
+  sentinel (a mid-loop compile invalidates the sample and shows up as
+  ``steady_compiles``), telemetry is decoded after the clock stops, and
+  each trial emits an ``autotune.trial`` tracer span carrying the
+  candidate config as span args — a tuning run under ``EVOTORCH_TRACE``
+  is inspectable in Perfetto next to the ask/eval/tell spans.
+- **The CLI** — ``python -m evotorch_tpu.observability.autotune``:
+  tunes the requested knob groups at bench-compatible shapes (the
+  ``BENCH_*`` env knobs are honored), records every candidate in the
+  measured-timing ledger, and persists each winner to the tuned-config
+  cache (:mod:`~evotorch_tpu.observability.timings`) that the eval stack
+  consults at setup time. A ``scripts/tpu_window.sh`` battery step runs
+  it on the real chip, so a few minutes of healthy tunnel self-tunes the
+  flagship shapes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import time
+from dataclasses import dataclass, field
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from . import tracer
+from .timings import (
+    TimingLedger,
+    TimingRecord,
+    TunedEntry,
+    _median,
+    dtype_label,
+    machine_fingerprint,
+    timings,
+)
+
+__all__ = [
+    "CandidateStats",
+    "CompactHarness",
+    "HostPipelineHarness",
+    "KnobGroup",
+    "KnobSpec",
+    "RefillHarness",
+    "SearchOutcome",
+    "analytic_prune",
+    "autotune_search",
+    "candidate_grid",
+    "neighborhood",
+    "successive_halving",
+]
+
+
+# ---------------------------------------------------------------------------
+# the pure search core (no jax, no clocks — tier-1 tests run it synthetically)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KnobSpec:
+    """One tunable knob: a name and its ORDERED value grid. ``refine``
+    marks knobs whose neighborhood may propose off-grid midpoints (widths
+    and chunk sizes are continuous-ish integers; a boolean or enum knob
+    sets it False)."""
+
+    name: str
+    values: Tuple[Any, ...]
+    refine: bool = True
+
+
+@dataclass(frozen=True)
+class KnobGroup:
+    """A named set of knobs tuned together (one cache entry per group)."""
+
+    name: str
+    knobs: Tuple[KnobSpec, ...]
+
+
+def candidate_grid(group: KnobGroup) -> List[Dict[str, Any]]:
+    """The full cartesian candidate grid, in deterministic knob-major
+    order (the order is load-bearing: ties in the search break toward
+    earlier candidates, so grids should list preferred defaults first)."""
+    names = [k.name for k in group.knobs]
+    return [
+        dict(zip(names, combo))
+        for combo in itertools.product(*(k.values for k in group.knobs))
+    ]
+
+
+def neighborhood(group: KnobGroup, config: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """One-knob-at-a-time refinements around ``config``: for each
+    refinable integer knob, the (rounded) midpoints between its current
+    value and the adjacent grid values. Off-grid by construction —
+    candidates already in the grid were already measured — and
+    deterministic (no randomness anywhere in the core)."""
+    out: List[Dict[str, Any]] = []
+    seen = set()
+    for knob in group.knobs:
+        if not knob.refine:
+            continue
+        current = config.get(knob.name)
+        if not isinstance(current, int):
+            continue
+        values = sorted(v for v in knob.values if isinstance(v, int))
+        if current not in values:
+            continue
+        i = values.index(current)
+        for j in (i - 1, i + 1):
+            if not (0 <= j < len(values)):
+                continue
+            mid = (current + values[j]) // 2
+            if mid in values or mid == current or mid <= 0:
+                continue
+            candidate = dict(config, **{knob.name: mid})
+            key = tuple(sorted(candidate.items()))
+            if key not in seen:
+                seen.add(key)
+                out.append(candidate)
+    return out
+
+
+def analytic_prune(
+    candidates: Sequence[Dict[str, Any]],
+    cost_fn: Optional[Callable[[Dict[str, Any]], Optional[Dict[str, Any]]]],
+    *,
+    hbm_budget_bytes: Optional[float] = None,
+    flops_bound: Optional[float] = None,
+) -> Tuple[List[Dict[str, Any]], List[Tuple[Dict[str, Any], str]], Dict[int, Dict]]:
+    """Reject candidates on the PR 9 cost model BEFORE any wall-clock is
+    spent on them: a candidate whose captured program analyzes over the
+    peak-HBM budget or the FLOPs bound never reaches the trial harness.
+
+    ``cost_fn(config)`` returns ``{"peak_bytes", "flops",
+    "compile_seconds"}`` (any field nullable) or ``None`` when no
+    analysis is available — unknown cost NEVER prunes (the guarded-
+    accessor discipline: missing analysis degrades, it doesn't reject).
+
+    Returns ``(kept, pruned, costs)`` where ``pruned`` carries the
+    human-readable reason and ``costs`` maps an index INTO ``kept`` (the
+    surviving candidates, in order) to its cost dict, so the caller can
+    attach ``compile_seconds`` to the matching measurement records."""
+    kept: List[Dict[str, Any]] = []
+    pruned: List[Tuple[Dict[str, Any], str]] = []
+    costs: Dict[int, Dict] = {}
+    for config in candidates:
+        cost = cost_fn(config) if cost_fn is not None else None
+        if cost is not None:
+            peak = cost.get("peak_bytes")
+            if (
+                hbm_budget_bytes is not None
+                and peak is not None
+                and peak > hbm_budget_bytes
+            ):
+                pruned.append(
+                    (
+                        config,
+                        f"peak_bytes {peak:.3g} exceeds HBM budget "
+                        f"{hbm_budget_bytes:.3g}",
+                    )
+                )
+                continue
+            flops = cost.get("flops")
+            if flops_bound is not None and flops is not None and flops > flops_bound:
+                pruned.append(
+                    (config, f"flops {flops:.3g} exceeds bound {flops_bound:.3g}")
+                )
+                continue
+        if cost is not None:
+            costs[len(kept)] = cost
+        kept.append(config)
+    return kept, pruned, costs
+
+
+@dataclass
+class CandidateStats:
+    """Accumulated measurement state of one candidate across rounds."""
+
+    config: Dict[str, Any]
+    samples: List[float] = field(default_factory=list)
+    occupancies: List[float] = field(default_factory=list)
+    steady_compiles: int = 0
+    refill_events: Optional[int] = None
+    queue_wait: Optional[int] = None
+    cost: Optional[Dict[str, Any]] = None
+
+    @property
+    def steps_per_sec(self) -> float:
+        """The headline figure: the MEDIAN of every timed sample."""
+        return _median(self.samples)
+
+    @property
+    def occupancy(self) -> Optional[float]:
+        return _median(self.occupancies) if self.occupancies else None
+
+    def merge(self, measurement: Dict[str, Any]) -> None:
+        self.samples.extend(measurement.get("samples", ()))
+        self.occupancies.extend(measurement.get("occupancies", ()))
+        self.steady_compiles += int(measurement.get("steady_compiles", 0))
+        for key in ("refill_events", "queue_wait"):
+            value = measurement.get(key)
+            if value is not None:
+                setattr(self, key, value)
+
+
+#: measure(configs, trials, round_index) -> one measurement dict per config,
+#: each {"samples": [...], "occupancies": [...], "steady_compiles": int, ...}
+MeasureFn = Callable[[List[Dict[str, Any]], int, int], List[Dict[str, Any]]]
+
+
+def successive_halving(
+    candidates: Sequence[Dict[str, Any]],
+    measure: MeasureFn,
+    *,
+    trials_per_round: int = 3,
+    survivor_frac: float = 0.5,
+    min_survivors: int = 2,
+    max_rounds: int = 2,
+) -> List[CandidateStats]:
+    """Successive halving on MEDIANS: every round measures all surviving
+    candidates (``trials_per_round`` more samples each — the harness
+    interleaves them in one process), then keeps the top
+    ``survivor_frac`` by median steps/s. Survivors accumulate samples
+    across rounds, so the final ranking rests on the most-measured
+    medians. Deterministic: ties break toward the earlier candidate."""
+    results = [CandidateStats(config=dict(c)) for c in candidates]
+    alive = list(range(len(results)))
+    trials = max(1, int(trials_per_round))
+    for round_index in range(max(1, int(max_rounds))):
+        if not alive:
+            break
+        measured = measure(
+            [results[i].config for i in alive], trials, round_index
+        )
+        for i, m in zip(alive, measured):
+            results[i].merge(m)
+        if len(alive) <= min_survivors:
+            break
+        ranked = sorted(alive, key=lambda i: (-results[i].steps_per_sec, i))
+        keep = max(min_survivors, math.ceil(len(alive) * survivor_frac))
+        alive = sorted(ranked[:keep])
+    return results
+
+
+def select_winner(
+    results: Sequence[CandidateStats], *, min_occupancy: Optional[float] = None
+) -> Optional[CandidateStats]:
+    """Highest median steps/s among measured candidates meeting the
+    occupancy floor — falling back to the unconstrained winner when none
+    do (a floor must never select nothing). Candidates that paid a
+    steady-state compile mid-trial are untrustworthy timings and lose to
+    any clean candidate."""
+    measured = [r for r in results if r.samples]
+    if not measured:
+        return None
+    clean = [r for r in measured if r.steady_compiles == 0]
+    pool = clean or measured
+    if min_occupancy is not None:
+        eligible = [
+            r for r in pool if r.occupancy is not None and r.occupancy >= min_occupancy
+        ]
+        if eligible:
+            pool = eligible
+    return max(pool, key=lambda r: r.steps_per_sec)
+
+
+@dataclass
+class SearchOutcome:
+    """Everything one group's search produced: ranked candidate stats
+    (grid + refinement), the analytically-pruned configs with reasons,
+    and the selected winner. ``cache_written`` is stamped by
+    :func:`tune_group`: False when the winner was withheld from the cache
+    (retrace-dirty timing, occupancy floor not met, or ``write_cache``
+    off)."""
+
+    results: List[CandidateStats]
+    pruned: List[Tuple[Dict[str, Any], str]]
+    winner: Optional[CandidateStats]
+    cache_written: bool = False
+
+
+def autotune_search(
+    group: KnobGroup,
+    measure: MeasureFn,
+    *,
+    cost_fn: Optional[Callable[[Dict[str, Any]], Optional[Dict[str, Any]]]] = None,
+    hbm_budget_bytes: Optional[float] = None,
+    flops_bound: Optional[float] = None,
+    trials_per_round: int = 3,
+    survivor_frac: float = 0.5,
+    min_survivors: int = 2,
+    max_rounds: int = 2,
+    min_occupancy: Optional[float] = None,
+    refine: bool = True,
+) -> SearchOutcome:
+    """The full (pure) search: grid → analytic prune → successive
+    halving → winner → one neighborhood-refinement round around the
+    winner (off-grid midpoints, themselves prune-checked) → final
+    winner. ``measure``/``cost_fn`` carry all the impurity; everything
+    here is deterministic given their outputs."""
+    grid = candidate_grid(group)
+    kept, pruned, costs = analytic_prune(
+        grid, cost_fn, hbm_budget_bytes=hbm_budget_bytes, flops_bound=flops_bound
+    )
+    results = successive_halving(
+        kept,
+        measure,
+        trials_per_round=trials_per_round,
+        survivor_frac=survivor_frac,
+        min_survivors=min_survivors,
+        max_rounds=max_rounds,
+    )
+    for index, cost in costs.items():
+        results[index].cost = cost
+    winner = select_winner(results, min_occupancy=min_occupancy)
+    if refine and winner is not None:
+        measured_keys = {tuple(sorted(r.config.items())) for r in results}
+        fresh = [
+            c
+            for c in neighborhood(group, winner.config)
+            if tuple(sorted(c.items())) not in measured_keys
+        ]
+        kept2, pruned2, costs2 = analytic_prune(
+            fresh,
+            cost_fn,
+            hbm_budget_bytes=hbm_budget_bytes,
+            flops_bound=flops_bound,
+        )
+        pruned.extend(pruned2)
+        if kept2:
+            refined = successive_halving(
+                kept2,
+                measure,
+                trials_per_round=trials_per_round,
+                survivor_frac=1.0,  # no halving inside one refinement round
+                min_survivors=len(kept2),
+                max_rounds=1,
+            )
+            for index, cost in costs2.items():
+                refined[index].cost = cost
+            results = results + refined
+            winner = select_winner(results, min_occupancy=min_occupancy)
+    return SearchOutcome(results=results, pruned=pruned, winner=winner)
+
+
+# ---------------------------------------------------------------------------
+# trial harnesses (the impure half: jax programs, clocks, telemetry)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TuneShape:
+    """The workload shape a tuning run measures at (bench-compatible)."""
+
+    env_name: str = "humanoid"
+    popsize: int = 1024
+    episode_length: int = 100
+    hidden: Tuple[int, ...] = (64, 64)
+    compute_dtype: Any = None  # e.g. jnp.bfloat16; None = float32
+    num_episodes: int = 1
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "env": self.env_name,
+            "popsize": self.popsize,
+            "episode_length": self.episode_length,
+        }
+
+
+class _BespokeHarness:
+    """Shared scaffolding of the bespoke-sim (device-program) harnesses:
+    one env/policy/population built once, per-call PRNG keys derived by
+    ``fold_in`` from a base key (never reused), interleaved timed trials
+    under the retrace sentinel, telemetry decoded after the clock stops,
+    and an ``autotune.trial`` tracer span per timed call."""
+
+    group = ""  # knob-group / cache-entry name
+    program = ""  # timing-ledger program name
+    #: per-group winner floor (subclasses override; None = throughput only)
+    default_min_occupancy: Optional[float] = None
+
+    def __init__(self, shape: TuneShape, *, seed: int = 0):
+        import jax
+        from functools import partial
+
+        from ..algorithms.functional import pgpe, pgpe_ask
+        from ..envs import make_env
+        from ..neuroevolution.net import FlatParamsPolicy, tanh_mlp
+        from ..neuroevolution.net.runningnorm import RunningNorm
+
+        self.shape = shape
+        self.env = make_env(shape.env_name)
+        self.policy = FlatParamsPolicy(
+            tanh_mlp(self.env.observation_size, self.env.action_size, shape.hidden)
+        )
+        import jax.numpy as jnp
+
+        state = pgpe(
+            center_init=jnp.zeros(self.policy.parameter_count, dtype=jnp.float32),
+            center_learning_rate=0.1,
+            stdev_learning_rate=0.1,
+            objective_sense="max",
+            stdev_init=0.1,
+        )
+        # one fixed population for every candidate and trial: candidates
+        # compete on the SAME work list, so schedule quality is the only
+        # difference being measured
+        ask = jax.jit(partial(pgpe_ask, popsize=shape.popsize))
+        self.values = ask(jax.random.key(seed), state)
+        jax.block_until_ready(self.values)
+        self.stats = RunningNorm(self.env.observation_size).stats
+        self._base_key = jax.random.key(seed + 1)
+        self._nonce = itertools.count()
+        self._episodes_baseline: Optional[Dict[str, Any]] = None
+        self._warmed_configs: set = set()
+
+    # -- per-candidate program runners (overridden) -------------------------
+    def run_once(self, config: Dict[str, Any], key, *, warmup: bool = False):
+        raise NotImplementedError
+
+    def tuned_config(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """Map harness knob names to the cache entry's config keys."""
+        return dict(config)
+
+    def default_config(self) -> Optional[Dict[str, Any]]:
+        """The built-in-default candidate — the anchor the relative HBM
+        budget is derived from (the default is definitionally feasible)."""
+        return None
+
+    def knob_group(self) -> KnobGroup:
+        raise NotImplementedError
+
+    def cost(self, config: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        return None
+
+    # -- the shared measurement machinery -----------------------------------
+    def _next_key(self):
+        import jax
+
+        # fold_in with a fresh nonce per timed call: unique per-call keys,
+        # no key ever consumed twice (the graftlint prng discipline)
+        return jax.random.fold_in(self._base_key, next(self._nonce))
+
+    def _timed_call(self, label: str, config: Dict[str, Any], runner):
+        """One timed trial: sentinel around the call, clock stopped at
+        ``block_until_ready``, telemetry decoded afterwards. Returns
+        ``(steps_per_sec, telemetry, compiles)``."""
+        import jax
+
+        from ..analysis import track_compiles
+        from . import EvalTelemetry
+
+        key = self._next_key()
+        with tracer.span("autotune.trial", "autotune", group=label, **config):
+            with track_compiles() as compile_log:
+                t0 = time.perf_counter()
+                result = runner(key)
+                jax.block_until_ready(result.scores)
+                elapsed = time.perf_counter() - t0
+        steps = int(result.total_steps)
+        telemetry = (
+            EvalTelemetry.from_array(result.telemetry)
+            if result.telemetry is not None
+            else None
+        )
+        return steps / elapsed if elapsed > 0 else 0.0, telemetry, compile_log.count
+
+    def measure(
+        self, configs: List[Dict[str, Any]], trials: int, round_index: int
+    ) -> List[Dict[str, Any]]:
+        """The real MeasureFn: warm every candidate once (compiles land
+        outside every clock), then interleave candidates within each
+        trial sweep — the CLAUDE.md ±20% rule — so drift hits all
+        candidates alike."""
+        for config in configs:
+            # warm once per candidate PER SEARCH (not per round): a warmup
+            # is a full untimed evaluation, and survivors of round 0 are
+            # already compiled
+            warm_key = tuple(sorted(config.items()))
+            if warm_key in self._warmed_configs:
+                continue
+            self._warmed_configs.add(warm_key)
+            self.run_once(config, self._next_key(), warmup=True)
+        out = [
+            {"samples": [], "occupancies": [], "steady_compiles": 0}
+            for _ in configs
+        ]
+        for _ in range(trials):
+            for i, config in enumerate(configs):
+                sps, telemetry, compiles = self._timed_call(
+                    self.group, config, lambda key, c=config: self.run_once(c, key)
+                )
+                out[i]["samples"].append(sps)
+                out[i]["steady_compiles"] += compiles
+                if telemetry is not None:
+                    out[i]["occupancies"].append(telemetry.occupancy)
+                    out[i]["refill_events"] = telemetry.refill_events
+                    out[i]["queue_wait"] = telemetry.queue_wait
+        return out
+
+    def baseline(self, trials: int = 3) -> Dict[str, Any]:
+        """Median steps/s of the monolithic ``episodes`` contract at the
+        same shape — the denominator of ``refill_speedup`` /
+        ``compaction_speedup`` (measured in the same process, same
+        population)."""
+        if self._episodes_baseline is not None:
+            return self._episodes_baseline
+        from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+        def runner(key):
+            return run_vectorized_rollout(
+                self.env,
+                self.policy,
+                self.values,
+                key,
+                self.stats,
+                eval_mode="episodes",
+                num_episodes=self.shape.num_episodes,
+                episode_length=self.shape.episode_length,
+                compute_dtype=self.shape.compute_dtype,
+            )
+
+        import jax
+
+        jax.block_until_ready(runner(self._next_key()).scores)  # warmup
+        samples, occupancies = [], []
+        for _ in range(max(1, trials)):
+            sps, telemetry, _ = self._timed_call(
+                "episodes", {"contract": "episodes"}, runner
+            )
+            samples.append(sps)
+            if telemetry is not None:
+                occupancies.append(telemetry.occupancy)
+        self._episodes_baseline = {
+            "steps_per_sec": _median(samples),
+            "occupancy": _median(occupancies) if occupancies else None,
+            "samples": samples,
+        }
+        return self._episodes_baseline
+
+
+def _pow2_menu(values, lo: int, hi: int) -> Tuple[int, ...]:
+    return tuple(sorted({int(v) for v in values if lo <= int(v) <= hi}))
+
+
+class RefillHarness(_BespokeHarness):
+    """Tunes the ``episodes_refill`` scheduler: lane width + refill
+    period. The width menu brackets the engine's work/8 default with the
+    fixed 64..512 rungs the r8 sweep used, so the search always measures
+    the default it might replace."""
+
+    group = "refill"
+    program = "rollout.episodes_refill"
+    #: the r8/acceptance bar: a refill schedule that starves lanes must
+    #: not win on a lucky throughput run
+    default_min_occupancy: Optional[float] = 0.9
+
+    def __init__(
+        self,
+        shape: TuneShape,
+        *,
+        widths: Optional[Sequence[int]] = None,
+        periods: Sequence[int] = (1,),
+        seed: int = 0,
+    ):
+        super().__init__(shape, seed=seed)
+        from ..neuroevolution.net.vecrl import _default_refill_width
+
+        total_items = shape.popsize * shape.num_episodes
+        if widths is None:
+            base = _default_refill_width(total_items)
+            widths = _pow2_menu(
+                (64, 128, 256, 512, base // 2, base, base * 2),
+                lo=8,
+                hi=total_items,
+            )
+        self.widths = tuple(int(w) for w in widths)
+        if not self.widths:
+            raise ValueError(
+                f"empty refill width menu for work-list size {total_items} "
+                "(the default rungs all fall outside [8, work]); pass "
+                "--widths explicitly"
+            )
+        self.periods = tuple(int(p) for p in periods)
+        self._default_width = min(
+            _default_refill_width(total_items), max(self.widths)
+        )
+
+    def default_config(self):
+        return {
+            "refill_width": self._default_width,
+            "refill_period": self.periods[0],
+        }
+
+    def knob_group(self) -> KnobGroup:
+        return KnobGroup(
+            name=self.group,
+            knobs=(
+                KnobSpec("refill_width", self.widths),
+                KnobSpec("refill_period", self.periods, refine=False),
+            ),
+        )
+
+    def run_once(self, config, key, *, warmup: bool = False):
+        from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+        result = run_vectorized_rollout(
+            self.env,
+            self.policy,
+            self.values,
+            key,
+            self.stats,
+            eval_mode="episodes_refill",
+            refill_width=int(config["refill_width"]),
+            refill_period=int(config.get("refill_period", 1)),
+            num_episodes=self.shape.num_episodes,
+            episode_length=self.shape.episode_length,
+            compute_dtype=self.shape.compute_dtype,
+        )
+        if warmup:
+            import jax
+
+            jax.block_until_ready(result.scores)
+        return result
+
+    def cost(self, config):
+        """PR 9 analytic cost of the candidate's compiled program (one
+        AOT capture — outside every timed region; the compile_seconds
+        figure lands in the timing record)."""
+        import jax
+
+        from .programs import ProgramLedger
+        from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+        led = ProgramLedger()
+        record = led.capture(
+            self.program,
+            run_vectorized_rollout,
+            self.env,
+            self.policy,
+            jax.ShapeDtypeStruct(self.values.shape, self.values.dtype),
+            jax.random.key(0),
+            self.stats,
+            shape=dict(self.shape.as_dict(), **config),
+            eval_mode="episodes_refill",
+            refill_width=int(config["refill_width"]),
+            refill_period=int(config.get("refill_period", 1)),
+            num_episodes=self.shape.num_episodes,
+            episode_length=self.shape.episode_length,
+            compute_dtype=self.shape.compute_dtype,
+        )
+        return {
+            "peak_bytes": record.peak_bytes,
+            "flops": record.flops,
+            "compile_seconds": record.compile_seconds,
+        }
+
+    def tuned_config(self, config):
+        return {
+            "width": int(config["refill_width"]),
+            "period": int(config.get("refill_period", 1)),
+        }
+
+
+class CompactHarness(_BespokeHarness):
+    """Tunes the lane-compacting runner: host chunk size × width-menu
+    floor (the grid ``scripts/tune_compact.py`` used to sweep — absorbed
+    here as one knob group)."""
+
+    group = "compact"
+    program = "rollout.episodes_compact"
+    #: compaction STRUCTURALLY runs below full occupancy (~0.5 at the
+    #: bench shapes — r8/r11 measurements): the contract pads each chunk
+    #: to its slowest survivor by design, so a refill-style 0.9 floor
+    #: would make every winner unpersistable. Select on throughput, the
+    #: original tune_compact criterion.
+    default_min_occupancy: Optional[float] = None
+
+    def __init__(
+        self,
+        shape: TuneShape,
+        *,
+        chunks: Sequence[int] = (10, 25, 50),
+        min_widths: Sequence[int] = (128, 256, 512),
+        seed: int = 0,
+    ):
+        super().__init__(shape, seed=seed)
+        total = shape.popsize * shape.num_episodes
+        self.chunks = tuple(int(c) for c in chunks)
+        self.min_widths = tuple(w for w in (int(w) for w in min_widths) if w < total)
+        if not self.min_widths:
+            raise ValueError(
+                f"no min_width candidate below the work-list size {total}; "
+                "pass --min-widths values smaller than popsize*num_episodes"
+            )
+
+    def default_config(self):
+        chunk = 25 if 25 in self.chunks else self.chunks[0]
+        width = 256 if 256 in self.min_widths else self.min_widths[0]
+        return {"chunk_size": chunk, "min_width": width}
+
+    def knob_group(self) -> KnobGroup:
+        return KnobGroup(
+            name=self.group,
+            knobs=(
+                KnobSpec("chunk_size", self.chunks),
+                KnobSpec("min_width", self.min_widths),
+            ),
+        )
+
+    def run_once(self, config, key, *, warmup: bool = False):
+        from ..neuroevolution.net.vecrl import run_vectorized_rollout_compacting
+
+        # the warmup call (one per candidate — the base class dedups) runs
+        # prewarm=True, compiling the candidate's whole width-descent chain
+        # (the chunk step count is static in the jitted chunk program), so
+        # timed calls stay compile-free
+        result = run_vectorized_rollout_compacting(
+            self.env,
+            self.policy,
+            self.values,
+            key,
+            self.stats,
+            chunk_size=int(config["chunk_size"]),
+            min_width=int(config["min_width"]),
+            prewarm=warmup,
+            num_episodes=self.shape.num_episodes,
+            episode_length=self.shape.episode_length,
+            compute_dtype=self.shape.compute_dtype,
+        )
+        if warmup:
+            import jax
+
+            jax.block_until_ready(result.scores)
+        return result
+
+    def cost(self, config):
+        """Cost of the full-width chunk program — the dominant compiled
+        unit of the host-orchestrated contract (the width descent reruns
+        the same program at narrower shapes)."""
+        from .inventory import capture_compact_chunk
+        from .programs import ProgramLedger
+
+        led = ProgramLedger()
+        record = capture_compact_chunk(
+            led,
+            self.env,
+            self.policy,
+            self.shape.popsize,
+            self.shape.episode_length,
+            chunk_size=int(config["chunk_size"]),
+            compute_dtype=self.shape.compute_dtype,
+            name=self.program + ".chunk",
+            shape=dict(self.shape.as_dict(), **config),
+        )
+        return {
+            "peak_bytes": record.peak_bytes,
+            "flops": record.flops,
+            "compile_seconds": record.compile_seconds,
+        }
+
+    def tuned_config(self, config):
+        return {
+            "chunk_size": int(config["chunk_size"]),
+            "min_width": int(config["min_width"]),
+        }
+
+
+class HostPipelineHarness:
+    """Tunes the HOST-path knobs: the pipelined scheduler's lane-block
+    count and (for MuJoCo backends) the physics thread-pool width. These
+    are machine properties — "2 blocks when a second core exists" is the
+    heuristic being replaced by a measured fact — so the cache entry is
+    machine-scoped (shape ``{}``), and every `GymNE`/host-pipeline run on
+    this machine inherits it."""
+
+    group = "host_pipeline"
+    program = "host_pipeline.rollout"
+    #: host-path occupancy has no device-starvation meaning comparable to
+    #: the refill contract's; select on throughput (no floor by default)
+    default_min_occupancy: Optional[float] = None
+
+    def __init__(
+        self,
+        env_id: Optional[str] = None,
+        *,
+        popsize: int = 64,
+        num_envs: int = 16,
+        episode_length: int = 200,
+        hidden: Tuple[int, ...] = (64, 64),
+        seed: int = 0,
+    ):
+        import gymnasium as gym
+        import numpy as np
+
+        from ..neuroevolution.net import FlatParamsPolicy, tanh_mlp
+
+        if env_id is None:
+            try:
+                from ..envs.mujoco.mjvecenv import MjVecEnv  # noqa: F401
+
+                env_id = "Hopper-v5"
+            except ImportError:
+                env_id = "CartPole-v1"
+        self.env_id = env_id
+        self.popsize = int(popsize)
+        self.num_envs = int(num_envs)
+        self.episode_length = int(episode_length)
+        probe = gym.make(env_id)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        act_space = probe.action_space
+        act_dim = (
+            int(act_space.n)
+            if hasattr(act_space, "n")
+            else int(np.prod(act_space.shape))
+        )
+        probe.close()
+        self.policy = FlatParamsPolicy(tanh_mlp(obs_dim, act_dim, hidden))
+        rng = np.random.default_rng(seed)
+        import jax.numpy as jnp
+
+        self.params = jnp.asarray(
+            rng.normal(size=(self.popsize, self.policy.parameter_count)),
+            jnp.float32,
+        )
+        self._mujoco = self._mujoco_backend()
+        self._warmed_splits: set = set()
+        self._sync_baseline: Optional[Dict[str, Any]] = None
+
+    def _mujoco_backend(self) -> bool:
+        try:
+            from ..envs.mujoco.mjvecenv import MjVecEnv
+
+            import gymnasium as gym
+
+            probe = MjVecEnv(lambda: gym.make(self.env_id), 1)
+            probe.close()
+            return True
+        except Exception:
+            return False
+
+    def default_config(self) -> Optional[Dict[str, Any]]:
+        return None  # no analytic cost model on the host path; grid[0] anchors
+
+    def knob_group(self) -> KnobGroup:
+        import os
+
+        blocks = tuple(b for b in (1, 2, 4) if b <= self.num_envs)
+        knobs = [KnobSpec("num_blocks", blocks, refine=False)]
+        if self._mujoco:
+            cores = int(os.cpu_count() or 1)
+            nthreads = tuple(sorted({1, 2, cores} & set(range(1, self.num_envs + 1))))
+            knobs.append(KnobSpec("mj_nthread", nthreads, refine=False))
+        return KnobGroup(name=self.group, knobs=tuple(knobs))
+
+    def cost(self, config):
+        return None  # host-orchestrated: no single XLA program to analyze
+
+    def _fresh_vec(self, config):
+        import gymnasium as gym
+
+        if self._mujoco:
+            from ..envs.mujoco.mjvecenv import MjVecEnv
+
+            vec = MjVecEnv(
+                lambda: gym.make(self.env_id),
+                self.num_envs,
+                nthread=config.get("mj_nthread"),
+            )
+        else:
+            from ..neuroevolution.net.hostvecenv import SyncVectorEnv
+
+            vec = SyncVectorEnv(lambda: gym.make(self.env_id), self.num_envs)
+        vec.seed(range(1000, 1000 + self.num_envs))
+        return vec
+
+    def _run(self, config, *, episode_length: Optional[int] = None, mode="pipelined"):
+        import numpy as np
+
+        from ..neuroevolution.net.hostvecenv import run_host_pipelined_rollout
+
+        vec = self._fresh_vec(config)
+        try:
+            t0 = time.perf_counter()
+            result = run_host_pipelined_rollout(
+                vec,
+                self.policy,
+                self.params,
+                num_episodes=1,
+                episode_length=(
+                    self.episode_length if episode_length is None else episode_length
+                ),
+                mode=mode,
+                num_blocks=config.get("num_blocks"),
+                # the tuner must never measure through its own previous
+                # output: the sync baseline (and any config with blocks
+                # unset) gets the PRISTINE heuristic, not a cached entry
+                use_tuned_cache=False,
+                rng=np.random.default_rng(0),
+            )
+            elapsed = time.perf_counter() - t0
+        finally:
+            vec.close()
+        return result["interactions"] / elapsed if elapsed else 0.0, result
+
+    def _warm(self, config):
+        """The gathered device forward is jitted per BLOCK WIDTH, so every
+        distinct block split must compile OUTSIDE the timed region — a
+        one-warmup-for-all approach would hand later candidates a mid-trial
+        compile (and with one trial, a compile-contaminated median)."""
+        split = (config.get("num_blocks"), config.get("mj_nthread"))
+        if split not in self._warmed_splits:
+            self._warmed_splits.add(split)
+            self._run(config, episode_length=3)
+
+    def measure(self, configs, trials, round_index):
+        from ..analysis import track_compiles
+
+        for config in configs:
+            self._warm(config)
+        out = [
+            {"samples": [], "occupancies": [], "steady_compiles": 0}
+            for _ in configs
+        ]
+        for _ in range(trials):
+            for i, config in enumerate(configs):
+                with tracer.span(
+                    "autotune.trial", "autotune", group=self.group, **config
+                ):
+                    with track_compiles() as compile_log:
+                        sps, result = self._run(config)
+                out[i]["samples"].append(sps)
+                out[i]["occupancies"].append(result["occupancy"])
+                out[i]["steady_compiles"] += compile_log.count
+        return out
+
+    def baseline(self, trials: int = 3) -> Dict[str, Any]:
+        """The sync-mode scheduler (same event order, no worker thread)
+        at default blocks — the pipelined/sync A/B denominator."""
+        if self._sync_baseline is not None:
+            return self._sync_baseline
+        samples = []
+        self._warm({})
+        for _ in range(max(1, trials)):
+            with tracer.span("autotune.trial", "autotune", group="host_sync"):
+                sps, _ = self._run({}, mode="sync")
+            samples.append(sps)
+        self._sync_baseline = {
+            "steps_per_sec": _median(samples),
+            "occupancy": None,
+            "samples": samples,
+        }
+        return self._sync_baseline
+
+    def tuned_config(self, config):
+        out = {"num_blocks": int(config["num_blocks"])}
+        if "mj_nthread" in config:
+            out["mj_nthread"] = int(config["mj_nthread"])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the tuning driver: search a harness, fill the ledger, persist the winner
+# ---------------------------------------------------------------------------
+
+
+def tune_group(
+    harness,
+    *,
+    trials: int = 3,
+    max_rounds: int = 2,
+    survivor_frac: float = 0.5,
+    min_occupancy="auto",
+    hbm_budget_bytes: Optional[float] = None,
+    hbm_budget_ratio: Optional[float] = 8.0,
+    flops_bound: Optional[float] = None,
+    refine: bool = True,
+    ledger_out: Optional[TimingLedger] = None,
+    cache_path=None,
+    write_cache: bool = True,
+) -> SearchOutcome:
+    """Run one knob group end to end: derive the HBM budget from the
+    DEFAULT candidate's analyzed peak (``hbm_budget_ratio`` — a
+    guardrail against pathological grid corners, generous enough to keep
+    every sane rung), search, land every candidate in the measured-timing
+    ledger, and persist the winner to the tuned-config cache.
+
+    ``min_occupancy="auto"`` takes the HARNESS's per-group floor
+    (``default_min_occupancy``): 0.9 for refill, none for compact —
+    whose contract structurally runs ~0.5 — and the host pipeline."""
+    if min_occupancy == "auto":
+        min_occupancy = getattr(harness, "default_min_occupancy", None)
+    led = ledger_out if ledger_out is not None else timings
+    group = harness.knob_group()
+    machine = machine_fingerprint()
+    cost_cache: Dict[Tuple, Optional[Dict]] = {}
+
+    def cost_fn(config):
+        key = tuple(sorted(config.items()))
+        if key not in cost_cache:
+            try:
+                cost_cache[key] = harness.cost(config)
+            except Exception:
+                cost_cache[key] = None  # no analysis never prunes
+        return cost_cache[key]
+
+    budget = hbm_budget_bytes
+    if budget is None and hbm_budget_ratio is not None:
+        anchor = harness.default_config() or candidate_grid(group)[0]
+        reference = cost_fn(anchor)
+        if reference is not None and reference.get("peak_bytes") is not None:
+            budget = float(reference["peak_bytes"]) * float(hbm_budget_ratio)
+
+    outcome = autotune_search(
+        group,
+        harness.measure,
+        cost_fn=cost_fn,
+        hbm_budget_bytes=budget,
+        flops_bound=flops_bound,
+        trials_per_round=trials,
+        survivor_frac=survivor_frac,
+        max_rounds=max_rounds,
+        min_occupancy=min_occupancy,
+        refine=refine,
+    )
+
+    shape = harness.shape.as_dict() if hasattr(harness, "shape") else {}
+    for stats in outcome.results:
+        led.add(
+            TimingRecord(
+                program=harness.program,
+                shape=shape,
+                machine=machine,
+                config=dict(stats.config),
+                samples=tuple(stats.samples),
+                occupancy=stats.occupancy,
+                refill_events=stats.refill_events,
+                queue_wait=stats.queue_wait,
+                compile_seconds=(
+                    None if stats.cost is None else stats.cost.get("compile_seconds")
+                ),
+                steady_compiles=stats.steady_compiles,
+            )
+        )
+    for config, reason in outcome.pruned:
+        led.add(
+            TimingRecord(
+                program=harness.program,
+                shape=shape,
+                machine=machine,
+                config=dict(config),
+                pruned=reason,
+            )
+        )
+
+    # NEVER persist an untrustworthy winner: a steady-state compile inside
+    # a timed trial means the medians are contaminated (the CLI additionally
+    # exits nonzero on this), and a winner that only exists because NO
+    # candidate met the occupancy floor (select_winner's unconstrained
+    # fallback) is exactly the lucky-run wide rung the floor exists to
+    # block — either one landing in the checked-in cache would be silently
+    # applied by every consumer while the battery retries
+    floor_met = outcome.winner is not None and (
+        min_occupancy is None
+        or (
+            outcome.winner.occupancy is not None
+            and outcome.winner.occupancy >= min_occupancy
+        )
+    )
+    if (
+        outcome.winner is not None
+        and outcome.winner.steady_compiles == 0
+        and floor_met
+        and write_cache
+    ):
+        from .timings import save_tuned_entry
+
+        baseline = harness.baseline(trials)
+        speedup = None
+        if baseline["steps_per_sec"]:
+            speedup = outcome.winner.steps_per_sec / baseline["steps_per_sec"]
+        cache_shape = _cache_shape(harness)
+        entry = TunedEntry(
+            group=harness.group,
+            shape=cache_shape,
+            machine=machine,
+            config=harness.tuned_config(outcome.winner.config),
+            evidence={
+                "steps_per_sec": round(outcome.winner.steps_per_sec, 1),
+                "occupancy": (
+                    None
+                    if outcome.winner.occupancy is None
+                    else round(outcome.winner.occupancy, 4)
+                ),
+                "baseline_steps_per_sec": round(baseline["steps_per_sec"], 1),
+                "speedup_vs_baseline": (
+                    None if speedup is None else round(speedup, 3)
+                ),
+                "trials": len(outcome.winner.samples),
+                "steady_compiles": outcome.winner.steady_compiles,
+                "episode_length": getattr(
+                    getattr(harness, "shape", None), "episode_length", None
+                ),
+                "tuned_at": time.strftime("%Y-%m-%d"),
+            },
+        )
+        save_tuned_entry(entry, cache_path)
+        outcome.cache_written = True
+    return outcome
+
+
+def _cache_shape(harness) -> Dict[str, Any]:
+    """The cache key's shape dict: (env, popsize, policy parameter count,
+    compute dtype) for the device-program groups — params/dtype because a
+    width tuned for a 64x64-f32 policy says nothing about a 256x256-bf16
+    one (different per-step FLOPs/HBM balance) — and machine-scoped
+    (empty) for the host-pipeline group, whose knobs are host properties."""
+    from .timings import canonical_env_label
+
+    if isinstance(harness, HostPipelineHarness):
+        return {}
+    return {
+        # canonicalized exactly like every consumer's lookup label — an
+        # entry written under "Hopper-v5" would never match "hopper"
+        "env": canonical_env_label(harness.shape.env_name),
+        "popsize": harness.shape.popsize,
+        # the FULL workload identity: episode length/count change the
+        # work-list size and refill frequency, and params/dtype change the
+        # per-step FLOPs/HBM balance — a schedule measured at one must not
+        # be applied to another under a "cache" label
+        "episode_length": harness.shape.episode_length,
+        "num_episodes": harness.shape.num_episodes,
+        "params": harness.policy.parameter_count,
+        "dtype": dtype_label(harness.shape.compute_dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _tpu_healthy() -> bool:
+    """A killable-subprocess TPU probe (the axon plugin can hang FOREVER
+    on first backend use when its tunnel is down — CLAUDE.md — which must
+    not wedge a tuning run) that additionally asserts a NON-CPU platform:
+    the plugin can also silently fall back to CPU, and a tuning run that
+    believed it measured the chip would stamp the battery's .ok with
+    CPU-measured entries (the false-fire mode tpu_watch.sh guards against
+    the same way)."""
+    import subprocess
+    import sys
+
+    try:
+        probe = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; ds = jax.devices(); "
+                "assert ds and ds[0].platform != 'cpu', ds; print(len(ds))",
+            ],
+            timeout=120,
+            capture_output=True,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _actual_backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def _setup_backend(force_cpu: bool) -> bool:
+    import os
+    import sys
+
+    use_cpu = force_cpu or os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if not use_cpu and not _tpu_healthy():
+        print("TPU backend unhealthy; falling back to CPU", file=sys.stderr)
+        use_cpu = True
+    if use_cpu:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    if use_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    return use_cpu
+
+
+def _shape_from_args(args, use_cpu: bool) -> TuneShape:
+    """The tuning shape, honoring the same BENCH_* knobs with the same
+    defaults as bench_common.bench_config — KEEP THE TWO IN SYNC: a cache
+    hit requires exact (env, popsize, params, dtype) equality, so a
+    default drifting here (or there) silently turns every bench lookup
+    into a fallback. (Duplicated rather than imported: the package must
+    not depend on the repo-root bench scripts.)"""
+    import json as _json
+    import os
+
+    import jax.numpy as jnp
+
+    popsize = args.popsize
+    if popsize is None:
+        popsize = int(os.environ.get("BENCH_POPSIZE", 1024 if use_cpu else 10_000))
+    episode_length = args.episode_length
+    if episode_length is None:
+        episode_length = int(
+            os.environ.get("BENCH_EPISODE_LENGTH", 100 if use_cpu else 200)
+        )
+    hidden_raw = args.hidden or os.environ.get("BENCH_HIDDEN", "64,64")
+    hidden = tuple(int(h) for h in hidden_raw.split(",") if h)
+    env_name = args.env or os.environ.get("BENCH_ENV", "humanoid")
+    env_kwargs = _json.loads(os.environ.get("BENCH_ENV_ARGS", "{}"))
+    if env_kwargs:
+        raise SystemExit(
+            "autotune keys the tuned-config cache by plain env name; "
+            "BENCH_ENV_ARGS would make the entry ambiguous — unset it"
+        )
+    compute_dtype = (
+        jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
+    )
+    return TuneShape(
+        env_name=env_name,
+        popsize=popsize,
+        episode_length=episode_length,
+        hidden=hidden,
+        compute_dtype=compute_dtype,
+    )
+
+
+def _emit(payload: dict) -> None:
+    import json as _json
+
+    print(_json.dumps(payload), flush=True)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m evotorch_tpu.observability.autotune",
+        description="Occupancy-driven autotuner: search the eval-schedule "
+        "knobs at bench-compatible shapes, record measured timings, persist "
+        "winners to the tuned-config cache (docs/observability.md).",
+    )
+    parser.add_argument(
+        "--group",
+        default="refill",
+        help="comma list of knob groups: refill, compact, host_pipeline",
+    )
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the 8-virtual-device CPU backend")
+    parser.add_argument("--env", default=None, help="env name (BENCH_ENV)")
+    parser.add_argument("--popsize", type=int, default=None)
+    parser.add_argument("--episode-length", type=int, default=None)
+    parser.add_argument("--hidden", default=None, help="comma list, e.g. 64,64")
+    parser.add_argument("--trials", type=int, default=3,
+                        help="timed trials per candidate per round (median "
+                        "of >=3 — the CLAUDE.md variance rule)")
+    parser.add_argument("--max-rounds", type=int, default=2,
+                        help="successive-halving rounds")
+    parser.add_argument("--min-occupancy", type=float, default=None,
+                        help="occupancy floor on the winner (default: each "
+                        "group's own floor — 0.9 for refill; none for "
+                        "compact, whose contract structurally runs ~0.5, "
+                        "and host_pipeline)")
+    parser.add_argument("--widths", default=None,
+                        help="refill width grid override (comma list)")
+    parser.add_argument("--periods", default="1",
+                        help="refill period grid (comma list)")
+    parser.add_argument("--chunks", default="10,25,50",
+                        help="compact chunk-size grid (comma list)")
+    parser.add_argument("--min-widths", default="128,256,512",
+                        help="compact width-menu-floor grid (comma list)")
+    parser.add_argument("--hbm-budget", type=float, default=None,
+                        help="absolute peak-HBM prune budget in bytes")
+    parser.add_argument("--hbm-budget-ratio", type=float, default=8.0,
+                        help="prune budget as a multiple of the default "
+                        "candidate's analyzed peak (None-able via 0)")
+    parser.add_argument("--flops-bound", type=float, default=None,
+                        help="absolute cost-model FLOPs prune bound")
+    parser.add_argument("--no-refine", action="store_true",
+                        help="skip the neighborhood-refinement round")
+    parser.add_argument("--no-write-cache", action="store_true",
+                        help="search + ledger only; don't touch "
+                        "tuned_configs.json")
+    parser.add_argument("--cache", default=None,
+                        help="alternate tuned_configs.json path")
+    parser.add_argument("--timings-out", default=None,
+                        help="write the measured-timing ledger JSON here")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    use_cpu = _setup_backend(args.cpu)
+    groups = [g.strip() for g in args.group.split(",") if g.strip()]
+    unknown = set(groups) - {"refill", "compact", "host_pipeline"}
+    if unknown:
+        parser.error(f"unknown group(s): {sorted(unknown)}")
+
+    shape = _shape_from_args(args, use_cpu)
+    ratio = args.hbm_budget_ratio if args.hbm_budget_ratio else None
+    session = TimingLedger()
+    rc = 0
+    for group_name in groups:
+        if group_name == "refill":
+            widths = (
+                [int(w) for w in args.widths.split(",") if w]
+                if args.widths
+                else None
+            )
+            periods = [int(p) for p in args.periods.split(",") if p]
+            harness = RefillHarness(
+                shape, widths=widths, periods=periods, seed=args.seed
+            )
+        elif group_name == "compact":
+            harness = CompactHarness(
+                shape,
+                chunks=[int(c) for c in args.chunks.split(",") if c],
+                min_widths=[int(w) for w in args.min_widths.split(",") if w],
+                seed=args.seed,
+            )
+        else:
+            harness = HostPipelineHarness(seed=args.seed)
+        print(
+            f"[autotune] group={group_name} shape={_cache_shape(harness)} "
+            f"machine={machine_fingerprint()}",
+            file=sys.stderr,
+        )
+        outcome = tune_group(
+            harness,
+            trials=args.trials,
+            max_rounds=args.max_rounds,
+            min_occupancy=(
+                args.min_occupancy if args.min_occupancy is not None else "auto"
+            ),
+            hbm_budget_bytes=args.hbm_budget,
+            hbm_budget_ratio=ratio,
+            flops_bound=args.flops_bound,
+            refine=not args.no_refine,
+            ledger_out=session,
+            cache_path=args.cache,
+            write_cache=not args.no_write_cache,
+        )
+        for stats in outcome.results:
+            _emit(
+                {
+                    "metric": "autotune_steps_per_sec",
+                    "group": group_name,
+                    "config": stats.config,
+                    "steps_per_sec": round(stats.steps_per_sec, 1),
+                    "occupancy": (
+                        None
+                        if stats.occupancy is None
+                        else round(stats.occupancy, 4)
+                    ),
+                    "trials": len(stats.samples),
+                    "steady_compiles": stats.steady_compiles,
+                }
+            )
+        for config, reason in outcome.pruned:
+            _emit(
+                {
+                    "metric": "autotune_pruned",
+                    "group": group_name,
+                    "config": config,
+                    "reason": reason,
+                }
+            )
+        if outcome.winner is None:
+            _emit({"metric": "autotune_winner", "group": group_name,
+                   "error": "no candidate produced a timing"})
+            rc = 1
+            continue
+        baseline = harness.baseline(args.trials)
+        speedup = (
+            outcome.winner.steps_per_sec / baseline["steps_per_sec"]
+            if baseline["steps_per_sec"]
+            else None
+        )
+        _emit(
+            {
+                "metric": "autotune_winner",
+                "group": group_name,
+                "config": harness.tuned_config(outcome.winner.config),
+                "steps_per_sec": round(outcome.winner.steps_per_sec, 1),
+                "occupancy": (
+                    None
+                    if outcome.winner.occupancy is None
+                    else round(outcome.winner.occupancy, 4)
+                ),
+                "baseline_steps_per_sec": round(baseline["steps_per_sec"], 1),
+                "speedup_vs_baseline": (
+                    None if speedup is None else round(speedup, 3)
+                ),
+                "steady_compiles": outcome.winner.steady_compiles,
+                "cache_written": outcome.cache_written,
+                # report the platform jax actually ran on, not the plan —
+                # a mid-run silent CPU fallback must not be labeled "tpu"
+                "backend": "cpu-fallback" if use_cpu else _actual_backend(),
+            }
+        )
+        # steady-state compiles inside a timed trial invalidate the run's
+        # claim to compile-free measurement — surfaced as a nonzero exit
+        # so the battery marks the step failed instead of stamping .ok
+        if outcome.winner.steady_compiles:
+            rc = 1
+    if args.timings_out:
+        session.save(args.timings_out)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

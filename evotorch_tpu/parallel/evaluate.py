@@ -111,7 +111,11 @@ def make_sharded_rollout_evaluator(
       so the same value means the same total lane count at any mesh size.
       This helper is the strict surface: it raises on a width not divisible
       by the mesh axis size, while the convenience knobs floor per shard
-      like compact_config's widths);
+      like compact_config's widths. With NO explicit width, the tuned-config
+      cache (``observability/timings.py``) is consulted per popsize — the
+      autotuner's measured winner for this (env, popsize, episode length/count, params, dtype, machine) — and
+      ``evaluator.tuned_config_source`` reports the branch taken:
+      override / cache / fallback);
     - obs-norm statistics merged with a psum — per-step deltas when
       ``stats_sync=True`` (mesh-global cohort), else one end-of-rollout delta
       merge (shard-local cohorts, the reference's per-actor semantics);
@@ -145,7 +149,17 @@ def make_sharded_rollout_evaluator(
         )
     if mesh is None:
         mesh = default_mesh((axis_name,))
-    if rollout_kwargs.get("refill_width") is not None:
+    refill_mode = rollout_kwargs.get("eval_mode") == "episodes_refill"
+    # GROUP-level override semantics, same as resolve_knobs everywhere
+    # else: ANY explicit refill knob (width OR period) disables the cache
+    # for the whole group — a cached width was measured at its cached
+    # period, so mixing it with a caller's period would be an unmeasured
+    # combination wearing a "cache" label
+    explicit_refill = refill_mode and (
+        rollout_kwargs.get("refill_width") is not None
+        or rollout_kwargs.get("refill_period") is not None
+    )
+    if refill_mode and rollout_kwargs.get("refill_width") is not None:
         width = int(rollout_kwargs["refill_width"])
         n_shards = mesh.shape[axis_name]
         if width % n_shards != 0:
@@ -156,6 +170,49 @@ def make_sharded_rollout_evaluator(
         rollout_kwargs["refill_width"] = width // n_shards
 
     def build(lowrank: bool, popsize: int):
+        # tuned-config cache (observability/timings.py): a refill
+        # evaluation with NO explicit width consults the checked-in
+        # tuned_configs.json for this (env, popsize, episode length/count, params, dtype, machine) — cache
+        # widths are GLOBAL, divided per shard with the convenience-knob
+        # flooring (only an explicit width gets the strict divisibility
+        # check above). Provenance: `evaluator.tuned_config_source`.
+        local_kwargs = dict(rollout_kwargs)
+        source = None
+        if refill_mode:
+            from ..observability.timings import (
+                SOURCE_CACHE,
+                SOURCE_FALLBACK,
+                SOURCE_OVERRIDE,
+                canonical_env_label,
+                dtype_label,
+                lookup_tuned,
+            )
+
+            if explicit_refill:
+                source = SOURCE_OVERRIDE
+            else:
+                entry = lookup_tuned(
+                    "refill",
+                    {
+                        "env": canonical_env_label(env),
+                        "popsize": popsize,
+                        "episode_length": rollout_kwargs.get("episode_length"),
+                        "num_episodes": rollout_kwargs.get("num_episodes", 1),
+                        "params": policy.parameter_count,
+                        "dtype": dtype_label(rollout_kwargs.get("compute_dtype")),
+                    },
+                )
+                if entry is not None and entry.config.get("width") is not None:
+                    n_shards = mesh.shape[axis_name]
+                    local_kwargs["refill_width"] = max(
+                        1, int(entry.config["width"]) // n_shards
+                    )
+                    if entry.config.get("period") is not None:
+                        local_kwargs["refill_period"] = int(entry.config["period"])
+                    source = SOURCE_CACHE
+                else:
+                    source = SOURCE_FALLBACK
+
         def local(values_shard, key, stats):
             result = run_vectorized_rollout(
                 env,
@@ -166,7 +223,7 @@ def make_sharded_rollout_evaluator(
                 lane_ids=global_lane_ids(axis_name, _params_popsize(values_shard)),
                 stats_sync_axis=axis_name if stats_sync else None,
                 seed_stride=popsize,
-                **rollout_kwargs,
+                **local_kwargs,
             )
             if stats_sync:
                 merged = result.stats  # per-step psums already mesh-global
@@ -193,7 +250,7 @@ def make_sharded_rollout_evaluator(
             )
 
         values_spec = _params_shard_spec(lowrank, axis_name)
-        return jax.jit(
+        fn = jax.jit(
             jax.shard_map(
                 local,
                 mesh=mesh,
@@ -202,6 +259,7 @@ def make_sharded_rollout_evaluator(
                 check_vma=False,
             )
         )
+        return fn, source
 
     # bounded LRU like vecrl's engine caches: an adaptive-popsize caller
     # compiles one shard_map program per distinct popsize, and compiled
@@ -211,7 +269,8 @@ def make_sharded_rollout_evaluator(
     def evaluator(values, key, stats):
         lowrank = isinstance(values, LowRankParamsBatch)
         popsize = _params_popsize(values)
-        fn = build(lowrank, popsize)
+        fn, source = build(lowrank, popsize)
+        evaluator.tuned_config_source = source
         scores, merged, steps, episodes, per_shard, telemetry = fn(values, key, stats)
         result = RolloutResult(
             scores=scores,
@@ -225,5 +284,8 @@ def make_sharded_rollout_evaluator(
     # the jitted (lowrank, popsize) -> shard_map program factory, exposed so
     # the program ledger can AOT-lower the exact executable the evaluator
     # dispatches (observability/inventory.py)
-    evaluator.program_builder = build
+    evaluator.program_builder = lambda lowrank, popsize: build(lowrank, popsize)[0]
+    # provenance of the LAST dispatched popsize's refill knobs ("override" /
+    # "cache" / "fallback"; None before the first refill-mode dispatch)
+    evaluator.tuned_config_source = None
     return evaluator

@@ -69,10 +69,16 @@ run wide_lowrank 1800 env BENCH_HIDDEN=256,256 BENCH_BF16=1 BENCH_LOWRANK=32 pyt
 # 3. fused-kernel micro-bench (justifies/revokes the opt-in flags)
 run bench_ops 1800 python bench_ops.py
 
-# 3b. compaction-knob sweep: chunk_size x width-menu floor on real lane-tile
-#     economics (r4 tuned these blind on CPU; this justifies or replaces
-#     the defaults)
-run tune_compact 2400 env BENCH_BF16=1 python scripts/tune_compact.py
+# 3b. autotuner: search the refill + compaction schedules at the flagship
+#     shape on the real chip — interleaved median-of-3 trials, on-device
+#     occupancy readout, analytic (peak-HBM) pruning off the program
+#     ledger — and persist the winners into the checked-in tuned-config
+#     cache, so a few minutes of healthy tunnel self-tunes the flagship
+#     shapes for real hardware (closes the telemetry->knobs loop;
+#     docs/observability.md "The autotuner"; absorbs the old tune_compact
+#     sweep as the compact knob group)
+run autotune 2400 env BENCH_BF16=1 python -m evotorch_tpu.observability.autotune \
+  --group refill,compact --timings-out "$OUT/autotune_timings.json"
 
 # 4. sharded bench on the single real chip (mesh of 1; exercise the path)
 run bench_multichip 1800 python bench_multichip.py

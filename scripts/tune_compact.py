@@ -1,125 +1,47 @@
-"""Sweep the lane-compaction knobs on real hardware.
+"""DEPRECATED: absorbed into the autotuner CLI.
 
-VERDICT r4 weak #1: chunk_size=25 and the 256-lane width-menu floor were
-chosen on a 1-core CPU, blind to the lane-tile/VMEM effects they are
-designed around. This script measures the episodes contract at the flagship
-config across a (chunk_size x min_width) grid — plus the monolithic
-``episodes`` baseline — and prints one JSON line per combo so the defaults
-can be justified or replaced with data (recorded in BENCH_NOTES.md).
+The chunk_size x min_width sweep this script ran is now the ``compact``
+knob group of ``python -m evotorch_tpu.observability.autotune`` — which
+adds interleaved median-of-3 trials, occupancy readout, retrace-sentinel
+validation, analytic (peak-HBM) pruning, and persists the winner to the
+tuned-config cache consulted by VecNE/bench (docs/observability.md "The
+autotuner").
 
-Knobs: TUNE_POPSIZE (default 10000 TPU / 1024 CPU), TUNE_EPISODE_LENGTH
-(200/100), TUNE_GENERATIONS (2), TUNE_CHUNKS ("10,25,50,100"),
-TUNE_MINWIDTHS ("128,512,0"; 0 = the runner's own default floor, which
-already resolves to 256 at the flagship popsize), BENCH_ENV /
-BENCH_ENV_ARGS (same as bench.py), BENCH_BF16=1 for bfloat16 compute.
+This shim maps the old TUNE_* env knobs onto the new CLI and forwards.
 """
 
-import json
 import os
 import sys
-import time
-from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench_common import build_policy, setup_backend  # noqa: E402
-
 
 def main():
-    use_cpu = setup_backend()
-    import jax
-    import jax.numpy as jnp
+    from evotorch_tpu.observability import autotune
 
-    from evotorch_tpu.algorithms.functional import pgpe_ask
-    from evotorch_tpu.envs import make_env
-    from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
-    from evotorch_tpu.neuroevolution.net.vecrl import (
-        run_vectorized_rollout,
-        run_vectorized_rollout_compacting,
+    argv = ["--group", "compact"]
+    if os.environ.get("TUNE_POPSIZE"):
+        argv += ["--popsize", os.environ["TUNE_POPSIZE"]]
+    if os.environ.get("TUNE_EPISODE_LENGTH"):
+        argv += ["--episode-length", os.environ["TUNE_EPISODE_LENGTH"]]
+    if os.environ.get("TUNE_CHUNKS"):
+        argv += ["--chunks", os.environ["TUNE_CHUNKS"]]
+    if os.environ.get("TUNE_MINWIDTHS"):
+        # the old sweep used 0 for "the runner's own default floor"; the
+        # autotuner grid takes concrete widths only
+        widths = ",".join(
+            w for w in os.environ["TUNE_MINWIDTHS"].split(",") if w.strip() not in ("", "0")
+        )
+        if widths:
+            argv += ["--min-widths", widths]
+    print(
+        "scripts/tune_compact.py is deprecated; forwarding to:\n"
+        f"  python -m evotorch_tpu.observability.autotune {' '.join(argv)}\n"
+        "(BENCH_ENV / BENCH_BF16 / BENCH_POPSIZE etc. are honored as before)",
+        file=sys.stderr,
     )
-    from bench_common import fresh_pgpe_state
-
-    backend = "cpu" if use_cpu else jax.default_backend()
-    popsize = int(os.environ.get("TUNE_POPSIZE", 1024 if use_cpu else 10_000))
-    episode_length = int(os.environ.get("TUNE_EPISODE_LENGTH", 100 if use_cpu else 200))
-    generations = int(os.environ.get("TUNE_GENERATIONS", 2))
-    chunks = [int(c) for c in os.environ.get("TUNE_CHUNKS", "10,25,50,100").split(",")]
-    # 256 is omitted from the default grid: at the flagship popsize the
-    # runner's own floor (0 = default) resolves to 256 already, and
-    # re-measuring it would waste ~25% of the TPU-window step budget
-    widths = [int(w) for w in os.environ.get("TUNE_MINWIDTHS", "128,512,0").split(",")]
-    compute_dtype = jnp.bfloat16 if os.environ.get("BENCH_BF16", "0") == "1" else None
-
-    env = make_env(
-        os.environ.get("BENCH_ENV", "humanoid"),
-        **json.loads(os.environ.get("BENCH_ENV_ARGS", "{}")),
-    )
-    policy = build_policy(env)
-    stats = RunningNorm(env.observation_size).stats
-    state = fresh_pgpe_state(policy.parameter_count)
-    values = jax.jit(partial(pgpe_ask, popsize=popsize))(jax.random.key(0), state)
-    jax.block_until_ready(values)
-    common = dict(num_episodes=1, episode_length=episode_length,
-                  compute_dtype=compute_dtype)
-
-    def time_combo(runner_kwargs, compacting: bool):
-        def once(key, prewarm=False):
-            if compacting:
-                r = run_vectorized_rollout_compacting(
-                    env, policy, values, key, stats, prewarm=prewarm,
-                    **runner_kwargs, **common,
-                )
-            else:
-                r = run_vectorized_rollout(
-                    env, policy, values, key, stats, eval_mode="episodes", **common
-                )
-            jax.block_until_ready(r.scores)
-            return int(r.total_steps)
-
-        once(jax.random.key(1), prewarm=True)  # compile (+ prewarm all jump pairs)
-        t0 = time.perf_counter()
-        steps = 0
-        for g in range(generations):
-            steps += once(jax.random.key(2 + g))
-        dt = time.perf_counter() - t0
-        return steps / dt
-
-    base_sps = time_combo({}, compacting=False)
-    print(json.dumps({
-        "metric": "compact_tuning_steps_per_sec", "config": "episodes_monolithic",
-        "steps_per_sec": round(base_sps, 1), "popsize": popsize,
-        "episode_length": episode_length, "backend": backend,
-        "compute_dtype": "bfloat16" if compute_dtype else "float32",
-    }), flush=True)
-
-    best = None
-    for chunk in chunks:
-        for width in widths:
-            kwargs = {"chunk_size": chunk}
-            if width:
-                kwargs["min_width"] = width
-            try:
-                sps = time_combo(kwargs, compacting=True)
-            except Exception as e:  # record instead of aborting the sweep
-                print(json.dumps({
-                    "metric": "compact_tuning_steps_per_sec",
-                    "chunk_size": chunk, "min_width": width or "default",
-                    "error": f"{type(e).__name__}: {e}"[:200],
-                }), flush=True)
-                continue
-            row = {
-                "metric": "compact_tuning_steps_per_sec",
-                "chunk_size": chunk, "min_width": width or "default",
-                "steps_per_sec": round(sps, 1),
-                "speedup_vs_monolithic": round(sps / base_sps, 3),
-                "backend": backend,
-            }
-            print(json.dumps(row), flush=True)
-            if best is None or sps > best["steps_per_sec"]:
-                best = row
-    if best is not None:
-        print(json.dumps({**best, "metric": "compact_tuning_best"}), flush=True)
+    return autotune.main(argv)
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -103,14 +103,17 @@ def test_sentinel_is_thread_safe():
     tracking blocks do not corrupt each other's logs."""
     import threading
 
-    f = jax.jit(lambda x: x / 7.0)
     errors = []
+    inner_counts = []
 
     def compile_on_thread(width):
         try:
+            # a fresh jit wrapper per thread, each at its own shape
+            f = jax.jit(lambda x: x / 7.0)
             with track_compiles() as log:
                 jax.block_until_ready(f(jnp.ones(width)))
             assert log.count_matching("<lambda>") >= 1, log.names
+            inner_counts.append(log.count_matching("<lambda>"))
         except Exception as exc:  # pragma: no cover - surfaced below
             errors.append(exc)
 
@@ -125,6 +128,28 @@ def test_sentinel_is_thread_safe():
             t.join()
     assert not errors
     assert outer.count_matching("<lambda>") == 3
+    # the outer sink is registered for the inner blocks' whole lifetime, so
+    # it must see AT LEAST whatever any inner block saw — under-counting
+    # here is the wrong-sink-unregistered registry bug this test once
+    # caught (value-equal CompileLogs + remove-by-equality)
+    assert outer.count_matching("<lambda>") >= max(inner_counts)
+
+
+def test_unregister_removes_by_identity_not_equality():
+    """Two overlapping logs that observed the SAME records are value-equal;
+    one block exiting must unregister ITS sink, not the first equal one —
+    the outer block keeps receiving later compiles (the exact silent
+    under-count the thread-safety test flushed out under full-suite load)."""
+    with track_compiles() as outer:
+        with track_compiles() as inner:
+            f = jax.jit(lambda x: x * 3.0)
+            jax.block_until_ready(f(jnp.ones(41)))
+        # inner exited with names == outer.names; outer MUST still be live
+        assert inner.names == outer.names
+        g = jax.jit(lambda x: x * 5.0)
+        jax.block_until_ready(g(jnp.ones(43)))
+    assert outer.count_matching("<lambda>") == 2
+    assert inner.count_matching("<lambda>") == 1
 
 
 def test_global_compile_counter_composes_with_scoped_sentinels():
