@@ -48,6 +48,12 @@ refill width/period inside ``modes``). ``BENCH_TUNED=0`` disables both
 the consult and the new keys — the line is then byte-compatible with
 r9/r10 output.
 
+``BENCH_COMPILE_CACHE=1`` enables the persistent XLA compilation cache
+(observability/compilecache.py; dir override ``EVOTORCH_COMPILE_CACHE_DIR``)
+and appends a ``compile_cache`` block — hit/miss counters and cold/warm
+provenance, so a recorded ``compile_seconds`` can be attributed to a real
+compile vs a cache deserialize. Default off; line byte-compatible.
+
 ``BENCH_BACKEND=mujoco`` additionally measures the REAL-MuJoCo host path
 (``MjVecEnv`` over ``mujoco.rollout``): the PR-2 synchronous fixed-chunk loop
 vs the Sebulba-style pipelined refill scheduler, reported as
@@ -102,6 +108,13 @@ def main():
     from evotorch_tpu.observability.programs import abstract_like
 
     cfg = bench_config(use_cpu)
+    if cfg["compile_cache"]:
+        # BENCH_COMPILE_CACHE=1: persistent XLA compile cache — the second
+        # process deserializes instead of recompiling; the line's
+        # `compile_cache` block says which happened (cold/warm provenance)
+        from evotorch_tpu.observability import enable_persistent_cache
+
+        enable_persistent_cache()
     popsize = cfg["popsize"]
     episode_length = cfg["episode_length"]
     generations = cfg["generations"]
@@ -379,6 +392,26 @@ def main():
             "model_efficiency",
         ):
             line[column] = primary.get(column)
+    if cfg["compile_cache"]:
+        # hit/miss counters from the persistent compile cache plus the
+        # derived provenance: "warm" = every program this process compiled
+        # was deserialized from the cache (a prior process paid the
+        # compiles), "cold" = at least one real compile, "mixed" otherwise
+        from evotorch_tpu.observability import cache_stats
+
+        stats_cc = cache_stats()
+        hits, misses = stats_cc["hits"], stats_cc["misses"]
+        provenance = (
+            "warm" if misses == 0 and hits > 0
+            else "cold" if hits == 0
+            else "mixed"
+        )
+        line["compile_cache"] = {
+            "provenance": provenance,
+            "hits": hits,
+            "misses": misses,
+            "dir": stats_cc["dir"],
+        }
     if cfg["mj_backend"]:
         # BENCH_BACKEND=mujoco: append the real-MuJoCo host-path columns
         # (sync chunked loop vs pipelined refill scheduler over MjVecEnv);

@@ -108,6 +108,12 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
         # when this (env, popsize, machine) was tuned
         # (docs/observability.md "The autotuner").
         "tuned": os.environ.get("BENCH_TUNED", "1") != "0",
+        # BENCH_COMPILE_CACHE=1 enables jax's persistent compilation cache
+        # (observability/compilecache.py) and appends a `compile_cache`
+        # block — hits/misses + cold/warm provenance — to the JSON line.
+        # Default off: serialized executables are machine-local artifacts
+        # and the default line stays byte-compatible.
+        "compile_cache": os.environ.get("BENCH_COMPILE_CACHE", "0") == "1",
         # BENCH_BACKEND=mujoco: ALSO measure the real-MuJoCo host path (sync
         # chunked loop vs the pipelined refill scheduler) and append the
         # mj_* columns to the JSON line. Default off: the four bespoke-sim
@@ -138,7 +144,7 @@ def _use_tuned_cache(cfg: dict, params) -> bool:
     return cfg["tuned"] and not cfg["env_kwargs"] and params is not None
 
 
-def _tuned_shape(cfg: dict, params) -> dict:
+def _tuned_shape(cfg: dict, params, mesh_label: str = "none") -> dict:
     from evotorch_tpu.observability.timings import canonical_env_label, dtype_label
 
     return {
@@ -148,10 +154,14 @@ def _tuned_shape(cfg: dict, params) -> dict:
         "num_episodes": 1,  # every bench contract evaluates one episode
         "params": params,
         "dtype": dtype_label(cfg["compute_dtype"]),
+        # "none" for the single-device bench; bench_multichip looks up
+        # under its own mesh label (a schedule tuned unsharded is not
+        # evidence for a sharded layout — parallel.mesh.mesh_label)
+        "mesh": mesh_label,
     }
 
 
-def tuned_compact(cfg: dict, *, n_shards: int = 1, params=None):
+def tuned_compact(cfg: dict, *, n_shards: int = 1, params=None, mesh_label: str = "none"):
     """Lane-compaction runner kwargs + ``tuned_config_source`` provenance:
     explicit ``BENCH_COMPACT_*`` knobs override; else (``BENCH_TUNED=1``,
     the default) the tuned-config cache entry for this
@@ -169,7 +179,7 @@ def tuned_compact(cfg: dict, *, n_shards: int = 1, params=None):
     config, source = resolve_knobs(
         explicit,
         "compact",
-        _tuned_shape(cfg, params),
+        _tuned_shape(cfg, params, mesh_label),
         use_cache=_use_tuned_cache(cfg, params),
     )
     kwargs = {"chunk_size": int(config.get("chunk_size", cfg["compact_chunk"]))}
@@ -178,13 +188,13 @@ def tuned_compact(cfg: dict, *, n_shards: int = 1, params=None):
     return kwargs, source
 
 
-def compact_kwargs(cfg: dict, *, n_shards: int = 1, params=None) -> dict:
+def compact_kwargs(cfg: dict, *, n_shards: int = 1, params=None, mesh_label: str = "none") -> dict:
     """The kwargs half of :func:`tuned_compact` (kept for callers that
     don't report provenance)."""
-    return tuned_compact(cfg, n_shards=n_shards, params=params)[0]
+    return tuned_compact(cfg, n_shards=n_shards, params=params, mesh_label=mesh_label)[0]
 
 
-def tuned_refill(cfg: dict, *, n_shards: int = 1, params=None):
+def tuned_refill(cfg: dict, *, n_shards: int = 1, params=None, mesh_label: str = "none"):
     """Lane-refill engine kwargs + ``tuned_config_source`` provenance —
     same precedence and cache key as :func:`tuned_compact`. The width
     knob is GLOBAL; pass ``n_shards`` to translate (flooring, like the
@@ -198,7 +208,7 @@ def tuned_refill(cfg: dict, *, n_shards: int = 1, params=None):
     config, source = resolve_knobs(
         explicit,
         "refill",
-        _tuned_shape(cfg, params),
+        _tuned_shape(cfg, params, mesh_label),
         use_cache=_use_tuned_cache(cfg, params),
     )
     kwargs = {
@@ -209,10 +219,10 @@ def tuned_refill(cfg: dict, *, n_shards: int = 1, params=None):
     return kwargs, source
 
 
-def refill_kwargs(cfg: dict, *, n_shards: int = 1, params=None) -> dict:
+def refill_kwargs(cfg: dict, *, n_shards: int = 1, params=None, mesh_label: str = "none") -> dict:
     """The kwargs half of :func:`tuned_refill` (kept for callers that
     don't report provenance)."""
-    return tuned_refill(cfg, n_shards=n_shards, params=params)[0]
+    return tuned_refill(cfg, n_shards=n_shards, params=params, mesh_label=mesh_label)[0]
 
 
 def _bench_mlp(obs_dim: int, act_dim: int):

@@ -386,6 +386,14 @@ class ProjectInfo:
                                 self.axis_names.add(k.value)
                 elif tail == "default_mesh" and node.args:
                     self._add_str_elts(node.args[0])
+            elif isinstance(node, ast.Assign):
+                # a module-level `MESH_AXES = ("pop", "model")` declaration
+                # (parallel/mesh.py) is the canonical axis registry: every
+                # name it lists is a known axis, so new axes are introduced
+                # by declaration, not by growing the lint baseline
+                for target in node.targets:
+                    if isinstance(target, ast.Name) and target.id == "MESH_AXES":
+                        self._add_str_elts(node.value)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 args = node.args
                 params = list(args.posonlyargs) + list(args.args)

@@ -826,12 +826,15 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         identity, exactly as a ``psum`` over one shard would.
 
         When a sharded evaluator is active (``use_sharded_evaluation`` or
-        ``num_actors``) and no interaction budget is set, the pipeline instead
-        runs the reference's *exact* distributed statistics
-        (``core.py:3156-3301`` + ``gaussian.py:199-272``): each mesh shard
-        samples its own sub-population, ranks **locally**, computes local
-        gradients, and a ``pmean`` replaces the main-process weighted average
-        (shards are equal-sized, so both weighting conventions coincide).
+        ``num_actors``) and no interaction budget is set, the pipeline runs
+        as one GSPMD program over the mesh — global key, global ranking:
+        the reference's single-process statistics at any mesh shape. Under
+        ``EVOTORCH_SHARD_MAP=1`` it instead reproduces the reference's
+        *exact* distributed statistics (``core.py:3156-3301`` +
+        ``gaussian.py:199-272``): each mesh shard samples its own
+        sub-population, ranks **locally**, computes local gradients, and a
+        ``pmean`` replaces the main-process weighted average (shards are
+        equal-sized, so both weighting conventions coincide).
 
         With ``lowrank_rank`` the population is sampled in factored (low-rank)
         form and gradients are computed from the factors in O(L * rank);
@@ -981,9 +984,9 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         self, distribution, popsize: int, *, obj_index: int, ranking_method, key,
         lowrank_rank: Optional[int] = None,
     ) -> dict:
-        """Shard-local sampling/ranking/gradients over the eval mesh
-        (reference semantics: per-actor local ranking,
-        ``core.py:3156-3301``)."""
+        """Sampling/ranking/gradients over the eval mesh — GSPMD global
+        ranking by default, the reference's per-actor local ranking
+        (``core.py:3156-3301``) under ``EVOTORCH_SHARD_MAP=1``."""
         from .parallel.grad import make_sharded_grad_estimator
 
         mesh = self._eval_mesh

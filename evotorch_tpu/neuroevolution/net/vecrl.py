@@ -309,6 +309,8 @@ def _rollout_init(
     compute_dtype,
     lane_ids=None,
     stats_sync_axis=None,
+    num_valid=None,
+    pad_episodes_done: int = 0,
 ):
     """Build the initial carry (full width) and the compute-dtype params.
 
@@ -316,12 +318,25 @@ def _rollout_init(
     lane_id)`` — realized randomness is therefore a per-lane property,
     independent of the working width (compaction), the batch composition,
     and the mesh topology (a sharded evaluation passing global ``lane_ids``
-    reproduces the unsharded one bit-for-bit)."""
+    reproduces the unsharded one bit-for-bit).
+
+    ``num_valid`` marks lanes with ``lane_ids >= num_valid`` as PADDING
+    (``parallel.make_sharded_rollout_evaluator`` pads an indivisible
+    popsize to the next mesh multiple): they start inactive with
+    ``episodes_done = pad_episodes_done`` (``num_episodes`` in episodes
+    mode, so the exit condition sees them as finished) and are excluded
+    from the initial statistics mask — padding never earns score credit
+    or counter/telemetry credit."""
     n = _params_popsize(params_batch)
     params_batch = _params_cast(params_batch, compute_dtype)
 
     if lane_ids is None:
         lane_ids = jnp.arange(n, dtype=jnp.int32)
+    valid = (
+        jnp.ones(n, dtype=bool)
+        if num_valid is None
+        else lane_ids < jnp.int32(num_valid)
+    )
     lane_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(lane_ids)
     pair = jax.vmap(lambda k: jax.random.split(k, 2))(lane_keys)
     lane_keys, reset_keys = pair[:, 0], pair[:, 1]
@@ -330,21 +345,26 @@ def _rollout_init(
         # the initial reset observations are fed to the policy at t=0, so
         # they belong in the normalization statistics (the reference updates
         # stats on every observation the policy consumes)
-        new_stats = stats_update(stats, obs, mask=jnp.ones(n, dtype=bool))
+        new_stats = stats_update(stats, obs, mask=valid)
         if stats_sync_axis is not None:
             new_stats = _stats_psum_merge(stats, new_stats, stats_sync_axis)
         stats = new_stats
 
     policy_states = _initial_policy_states(policy, n, compute_dtype)
 
+    episodes_done0 = (
+        jnp.zeros(n, dtype=jnp.int32)
+        if num_valid is None
+        else jnp.where(valid, 0, jnp.int32(pad_episodes_done))
+    )
     carry = RolloutCarry(
         env_states=env_states,
         obs=obs,
         policy_states=policy_states,
         scores=jnp.zeros(n),
-        episodes_done=jnp.zeros(n, dtype=jnp.int32),
+        episodes_done=episodes_done0,
         steps_in_episode=jnp.zeros(n, dtype=jnp.int32),
-        active=jnp.ones(n, dtype=bool),
+        active=valid,
         stats=stats,
         key=lane_keys,  # (n,) per-lane PRNG chains
         total_steps=jnp.zeros((), dtype=jnp.int32),
@@ -378,6 +398,7 @@ def _make_step(
     budget_mode: bool,
     stats_sync_axis=None,
     collect_telemetry: bool = True,
+    masked_width: bool = False,
 ):
     """One masked control step of the whole population, as a pure function
     ``step(params_batch, carry) -> carry``. Width is taken from the carry, so
@@ -486,9 +507,12 @@ def _make_step(
             obs_next = _lane_select(active, new_obs, c.obs)
             steps_in_episode = jnp.where(active, steps_in_episode, 0)
 
-        if budget_mode:
+        if budget_mode and not masked_width:
             total_steps = c.total_steps + n
         else:
+            # episodes modes, and budget under padding (``masked_width``:
+            # some lanes are permanently-inactive pad rows whose slots must
+            # not count as genuine interactions)
             total_steps = c.total_steps + jnp.sum(active_f.astype(jnp.int32))
         # normalization statistics come from the observations the policy will
         # actually consume next step: post-reset-selection obs, masked by the
@@ -539,6 +563,7 @@ def _make_step(
         "refill_period",
         "seed_stride",
         "telemetry",
+        "num_valid",
     ),
 )
 def run_vectorized_rollout(
@@ -562,6 +587,7 @@ def run_vectorized_rollout(
     refill_period: int = 1,
     seed_stride: Optional[int] = None,
     telemetry: bool = True,
+    num_valid: Optional[int] = None,
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
 
@@ -644,6 +670,15 @@ def run_vectorized_rollout(
             "eval_mode must be 'episodes', 'budget' or 'episodes_refill',"
             f" got {eval_mode!r}"
         )
+    n_total = _params_popsize(params_batch)
+    if num_valid is not None:
+        num_valid = int(num_valid)
+        if not (1 <= num_valid <= n_total):
+            raise ValueError(
+                f"num_valid={num_valid} must be in [1, popsize={n_total}]"
+            )
+        if num_valid == n_total:
+            num_valid = None  # no padding: compile the unmasked program
     max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
     if episode_length is not None:
         max_t = min(max_t, int(episode_length))
@@ -667,6 +702,7 @@ def run_vectorized_rollout(
             refill_period=refill_period,
             seed_stride=seed_stride,
             telemetry=telemetry,
+            num_valid=num_valid,
         )
     hard_cap = max_t * int(num_episodes) + 1
     budget_mode = eval_mode == "budget"
@@ -681,6 +717,11 @@ def run_vectorized_rollout(
         compute_dtype=compute_dtype,
         lane_ids=lane_ids,
         stats_sync_axis=stats_sync_axis,
+        num_valid=num_valid,
+        # episodes-mode padding lanes must look already-finished to the
+        # exit condition; budget-mode lanes never finish (masked inactive),
+        # so their episodes_done stays 0 and total_episodes needs no fixup
+        pad_episodes_done=0 if budget_mode else int(num_episodes),
     )
     step = _make_step(
         env,
@@ -695,6 +736,7 @@ def run_vectorized_rollout(
         budget_mode=budget_mode,
         stats_sync_axis=stats_sync_axis,
         collect_telemetry=telemetry,
+        masked_width=num_valid is not None,
     )
 
     ctx = _forward_ctx(policy, params_batch)
@@ -726,6 +768,12 @@ def run_vectorized_rollout(
         final = jax.lax.while_loop(cond, lambda c: step(params_batch, ctx, c), carry)
         mean_scores = final.scores / jnp.maximum(final.episodes_done, 1)
     total_episodes = jnp.sum(final.episodes_done)
+    if num_valid is not None and not budget_mode:
+        # padding lanes were initialized as already-finished; subtract their
+        # synthetic episodes_done so counters/telemetry report genuine work
+        total_episodes = total_episodes - jnp.int32(
+            (n_total - num_valid) * int(num_episodes)
+        )
     return RolloutResult(
         scores=mean_scores,
         stats=final.stats,
@@ -872,6 +920,7 @@ def _run_refill(
     refill_period,
     seed_stride,
     telemetry=True,
+    num_valid=None,
 ) -> RolloutResult:
     """The ``episodes_refill`` evaluation: exact ``episodes`` semantics (each
     solution is scored by the mean return of exactly ``num_episodes``
@@ -886,11 +935,15 @@ def _run_refill(
         # bit-identity to it holds for legacy keys too.
         key = jax.random.wrap_key_data(key)
     n = _params_popsize(params_batch)
-    total_items = n * int(num_episodes)
+    # under width padding (num_valid < n) the work queue only enumerates the
+    # genuine solutions: padding rows never receive items, so their eps_buf
+    # stays 0 and their mean score is an exact 0.0
+    nv = int(num_valid) if num_valid is not None else n
+    total_items = nv * int(num_episodes)
     width = refill_width if refill_width is not None else _default_refill_width(total_items)
     width = int(min(max(1, int(width)), total_items))
     period = max(1, int(refill_period))
-    stride = int(seed_stride) if seed_stride is not None else n
+    stride = int(seed_stride) if seed_stride is not None else nv
 
     params_batch = _params_cast(params_batch, compute_dtype)
     if lane_ids is None:
@@ -906,8 +959,8 @@ def _run_refill(
         see the ``run_vectorized_rollout`` docstring), for ANY width,
         sharded or not (``seed_stride`` must be the GLOBAL popsize on a
         sharded caller)."""
-        sol = items % n
-        ep = items // n
+        sol = items % nv
+        ep = items // nv
         seeds = lane_ids[sol] + ep * jnp.int32(stride)
         ik = jax.vmap(lambda s: jax.random.fold_in(key, s))(seeds)
         pair = jax.vmap(lambda k: jax.random.split(k, 2))(ik)

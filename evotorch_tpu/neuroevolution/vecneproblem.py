@@ -32,7 +32,6 @@ from .net.rl import ActClipLayer
 from .net.runningnorm import RunningNorm
 from .net.vecrl import (
     _params_popsize,
-    global_lane_ids,
     run_vectorized_rollout,
     run_vectorized_rollout_compacting,
     run_vectorized_rollout_compacting_sharded,
@@ -226,18 +225,20 @@ class VecNE(NEProblem):
         self._compact_prewarmed_sizes.add(popsize)
         return True
 
-    def _tuned_knobs(self, group: str, explicit: dict, popsize: int) -> dict:
+    def _tuned_knobs(
+        self, group: str, explicit: dict, popsize: int, mesh_label: str = "none"
+    ) -> dict:
         """One knob group resolved at eval-setup time with the shared
         precedence rule (``observability.timings.resolve_knobs``):
         explicit config > tuned-config cache hit for this
-        (env, popsize, episode length/count, params, dtype, machine) > the engine's built-in
-        default. Memoized per (group, popsize); the provenance of the
-        LAST resolution is what ``tuned_config_source`` reports (shapes
-        are identical generation to generation, so it is stable in steady
-        state)."""
+        (env, popsize, episode length/count, params, dtype, mesh label,
+        machine) > the engine's built-in default. Memoized per
+        (group, popsize, mesh); the provenance of the LAST resolution is
+        what ``tuned_config_source`` reports (shapes are identical
+        generation to generation, so it is stable in steady state)."""
         from ..observability.timings import dtype_label
 
-        memo_key = (group, popsize)
+        memo_key = (group, popsize, mesh_label)
         if memo_key not in self._tuned_resolution:
             shape = {
                 "env": self._env_label,
@@ -245,12 +246,14 @@ class VecNE(NEProblem):
                 # the FULL workload identity is the key: episode
                 # length/count set the work-list size and refill
                 # frequency; the policy's parameter count + compute dtype
-                # set the per-step FLOPs/HBM balance — a schedule tuned
-                # for one is not evidence for another
+                # set the per-step FLOPs/HBM balance; the mesh label pins
+                # the device layout — a schedule tuned for one is not
+                # evidence for another
                 "episode_length": self._episode_length,
                 "num_episodes": self._num_episodes,
                 "params": self._policy.parameter_count,
                 "dtype": dtype_label(self._compute_dtype),
+                "mesh": mesh_label,
             }
             self._tuned_resolution[memo_key] = resolve_knobs(
                 explicit, group, shape, use_cache=self._tuned_cacheable
@@ -264,10 +267,14 @@ class VecNE(NEProblem):
         else the tuned cache's (chunk_size, min_width) for this shape."""
         return dict(self._tuned_knobs("compact", self._compact_config, popsize))
 
-    def _sharded_compact_config(self, n_shards: int, popsize: int) -> dict:
+    def _sharded_compact_config(
+        self, n_shards: int, popsize: int, mesh_label: str = "none"
+    ) -> dict:
         """The per-shard form of the (global-width) compact config: widths
         divide by the shard count; chunk_size passes through."""
-        cfg = self._compact_kwargs(popsize)
+        cfg = dict(
+            self._tuned_knobs("compact", self._compact_config, popsize, mesh_label)
+        )
         if cfg.get("min_width") is not None:
             cfg["min_width"] = max(1, int(cfg["min_width"]) // n_shards)
         if cfg.get("allowed_widths") is not None:
@@ -359,8 +366,14 @@ class VecNE(NEProblem):
         generic resolver would warn: there is no plain objective_func)."""
 
     def _num_actors_mesh(self, popsize: int):
-        """Mesh for a pending ``num_actors`` request, sized to the largest
-        shard count <= the request that divides the population size."""
+        """Mesh for a pending ``num_actors`` request. The GSPMD evaluator
+        pads an indivisible popsize to the next mesh multiple (the padding
+        lanes are masked), so the request is honored exactly; the paths
+        that still require divisibility (``EVOTORCH_SHARD_MAP=1``, the
+        sharded compact runner) step down to the largest dividing shard
+        count, as before."""
+        from ..parallel.evaluate import _use_shard_map
+
         request = self._num_actors_requested
         if request is None:
             return None
@@ -372,8 +385,9 @@ class VecNE(NEProblem):
         else:
             n = min(int(request), jax.device_count())
         n = max(1, n)
-        while popsize % n != 0:
-            n -= 1
+        if _use_shard_map(None) or self._eval_mode == "episodes_compact":
+            while popsize % n != 0:
+                n -= 1
         if n <= 1:
             return None
         return default_mesh(("pop",), devices=jax.devices()[:n])
@@ -469,13 +483,57 @@ class VecNE(NEProblem):
             pickle.dump(payload, f)
 
     # ------------------------------------------------- sharded evaluation ---
-    def evaluate_sharded(self, batch: SolutionBatch, mesh=None, axis_name: str = "pop"):
-        """Evaluate with the population axis sharded over the mesh: each shard
-        rolls out its rows locally; obs-norm stats merge with a psum — the
-        collective form of the reference's actor delta-sync
-        (``gymne.py:524-573``, SURVEY.md §2.11)."""
-        from jax.sharding import PartitionSpec as P
+    def _sharded_rollout_evaluator(self, mesh, axis_name: str):
+        """The memoized GSPMD evaluator for this problem on ``mesh``
+        (``parallel.make_sharded_rollout_evaluator``). Per-mesh memoization
+        matters: the helper's compiled-program cache lives in its closure,
+        so rebuilding it every evaluation would retrace every generation."""
+        from ..parallel.evaluate import make_sharded_rollout_evaluator
 
+        memo = self.__dict__.setdefault("_sharded_evaluator_memo", {})
+        evaluator = memo.get(mesh)
+        if evaluator is None:
+            kwargs = dict(
+                num_episodes=self._num_episodes,
+                episode_length=self._episode_length,
+                observation_normalization=self._observation_normalization,
+                alive_bonus_schedule=self._alive_bonus_schedule,
+                decrease_rewards_by=self._decrease_rewards_by,
+                action_noise_stdev=self._action_noise_stdev,
+                compute_dtype=self._compute_dtype,
+                eval_mode=self._eval_mode,
+            )
+            if self._eval_mode == "episodes_refill":
+                # explicit knobs pass through GLOBAL (the helper's
+                # convention); with none, the helper consults the
+                # tuned-config cache per popsize at this mesh label
+                if self._refill_config.get("width") is not None:
+                    kwargs["refill_width"] = int(self._refill_config["width"])
+                if self._refill_config.get("period") is not None:
+                    kwargs["refill_period"] = int(self._refill_config["period"])
+            evaluator = memo[mesh] = make_sharded_rollout_evaluator(
+                self._env,
+                self._policy,
+                mesh=mesh,
+                axis_name=axis_name,
+                stats_sync=(
+                    self._observation_normalization and self._obs_norm_sync == "step"
+                ),
+                **kwargs,
+            )
+        return evaluator
+
+    def evaluate_sharded(self, batch: SolutionBatch, mesh=None, axis_name: str = "pop"):
+        """Evaluate with the population axis sharded over the mesh
+        (``parallel.make_sharded_rollout_evaluator``): the GSPMD form — one
+        global program pinned to the mesh layout, bit-identical to the
+        unsharded evaluation, popsizes that don't divide the mesh padded
+        and masked, and the obs-norm cohort always mesh-GLOBAL (under
+        ``EVOTORCH_SHARD_MAP=1`` the explicit per-shard form returns, with
+        its strict divisibility and per-shard cohort semantics — the
+        collective analog of the reference's actor delta-sync,
+        ``gymne.py:524-573``, SURVEY.md §2.11). The host-orchestrated
+        ``episodes_compact`` contract keeps its dedicated sharded runner."""
         if mesh is None:
             mesh = default_mesh((axis_name,))
         n_shards = mesh.shape[axis_name]
@@ -484,12 +542,16 @@ class VecNE(NEProblem):
         if not is_lowrank:
             values = jnp.asarray(values)
         n = len(batch)
-        if n % n_shards != 0:
-            raise ValueError(f"Population size {n} must be divisible by mesh size {n_shards}")
 
         stats = self._obs_norm.stats
         obsnorm = self._observation_normalization
         if self._eval_mode == "episodes_compact":
+            from ..parallel.mesh import mesh_label
+
+            if n % n_shards != 0:
+                raise ValueError(
+                    f"Population size {n} must be divisible by mesh size {n_shards}"
+                )
             # the sharded compacting runner: jitted chunks shard_mapped over
             # the mesh, host-side width decisions between chunks — each shard
             # narrows its working set as its lanes finish (VERDICT r3 #5)
@@ -510,7 +572,7 @@ class VecNE(NEProblem):
                 compute_dtype=self._compute_dtype,
                 prewarm=self._take_prewarm(n),
                 stats_sync=(obsnorm and self._obs_norm_sync == "step"),
-                **self._sharded_compact_config(n_shards, n),
+                **self._sharded_compact_config(n_shards, n, mesh_label(mesh)),
             )
             if obsnorm:
                 self._obs_norm.stats = result.stats
@@ -519,82 +581,19 @@ class VecNE(NEProblem):
             batch.set_evals(result.scores)
             self.update_status(self._report_counters(batch))
             return
-        eval_mode = self._eval_mode
-        refill_kwargs = {}
-        if eval_mode == "episodes_refill":
-            # per-shard queues: each shard refills its own lanes from its own
-            # local work-list. seed_stride = GLOBAL popsize keeps every
-            # (solution, episode) seed unique across shards, so the sharded
-            # evaluation reproduces the unsharded one (bit-for-bit without
-            # observation normalization)
-            refill_kwargs = dict(self._refill_kwargs(n, n_shards), seed_stride=n)
 
-        step_sync = obsnorm and self._obs_norm_sync == "step"
-
-        def local(values_shard, key, stats):
-            # per-lane PRNG chains seeded by GLOBAL lane ids (same key on
-            # every shard): sharded evaluation == unsharded (bit-for-bit
-            # when observation normalization is off; see the eval_mode notes)
-            result = run_vectorized_rollout(
-                self._env,
-                self._policy,
-                values_shard,
-                key,
-                stats,
-                lane_ids=global_lane_ids(axis_name, _params_popsize(values_shard)),
-                num_episodes=self._num_episodes,
-                episode_length=self._episode_length,
-                observation_normalization=obsnorm,
-                alive_bonus_schedule=self._alive_bonus_schedule,
-                decrease_rewards_by=self._decrease_rewards_by,
-                action_noise_stdev=self._action_noise_stdev,
-                compute_dtype=self._compute_dtype,
-                eval_mode=eval_mode,
-                stats_sync_axis=axis_name if step_sync else None,
-                **refill_kwargs,
-            )
-            if step_sync:
-                # the per-step psum already made every shard's stats
-                # mesh-global; a final delta merge would double-count
-                merged = result.stats
-            else:
-                # merge the per-shard stat deltas with a psum
-                delta = jax.tree_util.tree_map(
-                    lambda new, old: new - old, result.stats, stats
-                )
-                merged = jax.tree_util.tree_map(
-                    lambda old, d: old + jax.lax.psum(d, axis_name), stats, delta
-                )
-            return (
-                result.scores,
-                merged,
-                jax.lax.psum(result.total_steps, axis_name),
-                jax.lax.psum(result.total_episodes, axis_name),
-                # additive telemetry slots: the mesh-global vector is a psum
-                jax.lax.psum(result.telemetry, axis_name),
-            )
-
-        # a factored population shards its per-lane COEFFICIENTS over the
-        # mesh; the shared center/basis replicate — per-device traffic is
-        # O(L*k + N_local*k) instead of O(N_local*L)
-        from .net.vecrl import _params_shard_spec
-
-        values_spec = _params_shard_spec(is_lowrank, axis_name)
-        sharded = jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(values_spec, P(), P()),
-            out_specs=(P(axis_name), P(), P(), P(), P()),
-            check_vma=False,
-        )
-        scores, merged_stats, steps, episodes, telemetry = sharded(
-            values, self.next_rng_key(), stats
-        )
+        evaluator = self._sharded_rollout_evaluator(mesh, axis_name)
+        result, _per_shard = evaluator(values, self.next_rng_key(), stats)
+        if evaluator.tuned_config_source is not None:
+            # the helper resolved the refill knobs (explicit config >
+            # tuned cache at this mesh label > engine default): surface
+            # its provenance through the usual status key
+            self._tuned_config_source = evaluator.tuned_config_source
         if obsnorm:
-            self._obs_norm.stats = jax.tree_util.tree_map(lambda x: x, merged_stats)
-        self._bump_counters(steps, episodes)
-        self._consume_telemetry(telemetry)
-        batch.set_evals(scores)
+            self._obs_norm.stats = result.stats
+        self._bump_counters(result.total_steps, result.total_episodes)
+        self._consume_telemetry(result.telemetry)
+        batch.set_evals(result.scores)
         self.update_status(self._report_counters(batch))
 
 

@@ -37,6 +37,10 @@ Three cooperating pieces (docs/observability.md has the full catalog):
   (:mod:`~evotorch_tpu.observability.autotune`).
 """
 
+from .compilecache import (  # noqa: F401
+    cache_stats,
+    enable_persistent_cache,
+)
 from .devicemetrics import (  # noqa: F401
     EvalTelemetry,
     TELEMETRY_WIDTH,
@@ -89,6 +93,8 @@ from .tracer import (  # noqa: F401
 )
 
 __all__ = [
+    "cache_stats",
+    "enable_persistent_cache",
     "EvalTelemetry",
     "TELEMETRY_WIDTH",
     "pack_eval_telemetry",

@@ -1174,6 +1174,10 @@ def _cache_shape(harness) -> Dict[str, Any]:
         "num_episodes": harness.shape.num_episodes,
         "params": harness.policy.parameter_count,
         "dtype": dtype_label(harness.shape.compute_dtype),
+        # the autotuner measures on the unsharded single-device program;
+        # sharded consumers look up under their own mesh label and never
+        # inherit these entries (parallel.mesh.mesh_label)
+        "mesh": "none",
     }
 
 

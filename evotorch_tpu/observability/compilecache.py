@@ -1,0 +1,99 @@
+"""Persistent XLA compilation cache wiring.
+
+jax can serialize compiled executables to disk and reload them in later
+processes (``jax_compilation_cache_dir``).  For this repo's programs the
+win is large: the flagship rollout program takes ~10 s to compile cold on
+this box and ~2 s to deserialize warm, so every bench / battery / curve
+process after the first skips most of its startup tax.
+
+:func:`enable_persistent_cache` turns the cache on with thresholds
+lowered to "cache everything" (the defaults skip entries that compiled in
+under a second, which covers most of our CPU-mesh test programs), and
+registers monitoring listeners so callers can report hit/miss provenance
+(:func:`cache_stats`) — bench.py uses this for its ``compile_cache``
+JSON keys, and the warm-start acceptance test asserts hits > 0 in the
+second process.
+
+The default cache directory lives next to the bench output dirs and is
+gitignored: serialized executables are machine- and jax-version-specific
+artifacts, not source.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+
+# Sibling of bench_curves/ at the repo root; gitignored (machine-local).
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "compile_cache",
+)
+
+_COUNTS: Dict[str, int] = {"hits": 0, "misses": 0}
+_LISTENER_INSTALLED = False
+_ENABLED_DIR: Optional[str] = None
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _install_listener() -> None:
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    try:
+        from jax._src import monitoring
+    except ImportError:  # pragma: no cover - jax internals moved
+        return
+
+    def _on_event(event: str, **kwargs) -> None:
+        if event == _HIT_EVENT:
+            _COUNTS["hits"] += 1
+        elif event == _MISS_EVENT:
+            _COUNTS["misses"] += 1
+
+    monitoring.register_event_listener(_on_event)
+    _LISTENER_INSTALLED = True
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
+    """Enable jax's persistent compilation cache rooted at ``cache_dir``.
+
+    Thresholds are dropped to zero so even fast-compiling programs are
+    cached — on a 1-core box the *second* process's wall clock is what we
+    are buying, and deserialization is cheap at every size.  Returns the
+    directory in use.  Idempotent; re-enabling with a different directory
+    re-points the cache.
+    """
+    global _ENABLED_DIR
+    path = os.path.abspath(cache_dir or os.environ.get("EVOTORCH_COMPILE_CACHE_DIR") or DEFAULT_CACHE_DIR)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    try:
+        # Also cache XLA-internal autotuning artifacts where supported.
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:
+        pass
+    _install_listener()
+    _ENABLED_DIR = path
+    return path
+
+
+def cache_stats() -> Dict[str, object]:
+    """Hit/miss counters since :func:`enable_persistent_cache` (this process)."""
+    return {
+        "enabled": _ENABLED_DIR is not None,
+        "dir": _ENABLED_DIR,
+        "hits": _COUNTS["hits"],
+        "misses": _COUNTS["misses"],
+    }
+
+
+def reset_stats() -> None:
+    _COUNTS["hits"] = 0
+    _COUNTS["misses"] = 0

@@ -3,7 +3,7 @@
 One place declares *which* compiled programs constitute the framework —
 the four eval-contract rollout programs, the sharded evaluator, the
 gaussian functional ask/tell, the batched functional search, and the
-bench/multichip whole-generation steps — so the program ledger
+bench/multichip/GSPMD whole-generation steps — so the program ledger
 (:mod:`~evotorch_tpu.observability.programs`), the report CLI and the
 fast-tier perf-regression gate all see the same surface.
 
@@ -225,6 +225,32 @@ def _multichip_generation_program(
         return pgpe_tell(state, values, scores), stats, per_shard
 
     return jax.jit(_generation, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=8)
+def _gspmd_generation_program(env, policy, mesh_size, popsize, episode_length):
+    """parallel.make_generation_step at the gate shape: ask -> GSPMD-sharded
+    rollout -> tell compiled as ONE global program over a ("pop",) mesh with
+    the evolution state donated end-to-end (docs/sharding.md)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..algorithms.functional import pgpe_ask, pgpe_tell
+    from ..parallel.evaluate import make_generation_step
+
+    mesh = Mesh(np.asarray(jax.devices()[:mesh_size]), axis_names=("pop",))
+    return make_generation_step(
+        env,
+        policy,
+        ask=lambda k, s: pgpe_ask(k, s, popsize=popsize),
+        tell=pgpe_tell,
+        popsize=popsize,
+        mesh=mesh,
+        num_episodes=1,
+        episode_length=episode_length,
+        eval_mode="budget",
+    )
 
 
 def capture_compact_chunk(
@@ -469,6 +495,21 @@ def build_specs(cfg: Optional[GateConfig] = None) -> List[ProgramSpec]:
         )
 
     add("multichip.generation", sharded_shape, multichip_capture)
+
+    def gspmd_capture(led):
+        fn = _gspmd_generation_program(
+            env, policy, mesh_size, cfg.popsize, cfg.episode_length
+        )
+        return led.capture(
+            "gspmd.generation",
+            fn,
+            _abstract(_fresh_pgpe_state(L)),
+            jax.random.key(0),
+            stats,
+            shape=sharded_shape,
+        )
+
+    add("gspmd.generation", sharded_shape, gspmd_capture)
     return specs
 
 
@@ -562,6 +603,14 @@ def donated_programs(cfg: Optional[GateConfig] = None):
         (
             "multichip.generation",
             _multichip_generation_program(
+                env, policy, mesh_size, cfg.popsize, cfg.episode_length
+            ),
+            (_fresh_pgpe_state(L), jax.random.key(0), stats),
+            (0,),
+        ),
+        (
+            "gspmd.generation",
+            _gspmd_generation_program(
                 env, policy, mesh_size, cfg.popsize, cfg.episode_length
             ),
             (_fresh_pgpe_state(L), jax.random.key(0), stats),

@@ -400,10 +400,22 @@ def lookup_tuned(
 ) -> Optional[TunedEntry]:
     """The cache hit for ``(group, shape)`` on this machine (exact key
     match), or ``None``. A miss is normal — it just means the built-in
-    fallback default applies (``tuned_config_source="fallback"``)."""
+    fallback default applies (``tuned_config_source="fallback"``).
+
+    Backward-compatible read of pre-mesh (version-1) caches: when the
+    lookup shape says ``"mesh": "none"`` (an UNSHARDED evaluation) and the
+    exact key misses, the lookup retries without the ``mesh`` field —
+    legacy entries were all measured unsharded, so they keep serving
+    unsharded consumers; a sharded lookup (any other mesh label) never
+    falls back to them (a width tuned without a mesh says nothing about a
+    sharded layout — ``parallel.mesh.mesh_label``)."""
     machine = machine if machine is not None else machine_fingerprint()
     cache = load_tuned_cache(path)
-    return cache.get(timing_key(group, shape, machine))
+    entry = cache.get(timing_key(group, shape, machine))
+    if entry is None and shape.get("mesh") == "none":
+        legacy_shape = {k: v for k, v in shape.items() if k != "mesh"}
+        entry = cache.get(timing_key(group, legacy_shape, machine))
+    return entry
 
 
 def save_tuned_entry(entry: TunedEntry, path=None) -> Path:
@@ -419,7 +431,10 @@ def save_tuned_entry(entry: TunedEntry, path=None) -> Path:
     entries = dict(load_tuned_cache(target, force=True))
     entries[entry.key] = entry
     payload = {
-        "version": 1,
+        # version 2: entry shapes carry a "mesh" label (parallel.mesh
+        # .mesh_label). Version-1 entries (no mesh key) remain readable —
+        # lookup_tuned serves them to unsharded ("mesh": "none") consumers
+        "version": 2,
         "entries": [entries[k].to_json() for k in sorted(entries)],
     }
     tmp = target.with_name(target.name + ".tmp")

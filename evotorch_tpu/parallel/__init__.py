@@ -4,40 +4,61 @@ This module replaces the reference's entire Ray actor layer
 (``core.py:115-356`` ``EvaluationActor``, ``core.py:1977-2052``
 ``Problem._parallelize`` + ``ActorPool``, ``core.py:2762-3073`` distributed
 gradient sampling, and the main<->actor sync protocol ``core.py:2239-2332``)
-with XLA collectives over a ``jax.sharding.Mesh``:
+with GSPMD over a ``jax.sharding.Mesh`` (``docs/sharding.md``):
 
-- population evaluation  -> ``shard_map`` over the population axis
-  (one program, population rows sharded across devices via ICI);
-- ES-gradient estimation -> local sample/evaluate/rank/grad per shard,
-  then ``pmean`` (this *is* the reference's weighted average of per-actor
-  gradients, ``gaussian.py:246-271``, expressed as a collective);
-- obs-norm stat merging  -> ``psum`` of (count, sum, sumsq) — see
-  ``neuroevolution.net.runningnorm``;
-- multi-host             -> ``jax.distributed.initialize`` over DCN.
+- population evaluation  -> the GLOBAL program jitted once, population rows
+  pinned to the mesh with ``NamedSharding`` / ``with_sharding_constraint``;
+  XLA's SPMD partitioner inserts the collectives (the explicit
+  ``shard_map`` + ``psum`` form survives behind ``EVOTORCH_SHARD_MAP=1``);
+- whole generations      -> ``make_generation_step``: ask -> rollout -> tell
+  as ONE donated-buffer program (steady-state HBM = one generation's live
+  set, verified by the program ledger);
+- ES-gradient estimation -> global sample/rank/grad under GSPMD (the
+  reference's single-process semantics at any popsize; the compat knob keeps
+  the per-actor local-ranking form, ``gaussian.py:246-271``);
+- obs-norm stat merging  -> the global program's cohort IS the mesh-global
+  population — see ``neuroevolution.net.runningnorm``;
+- multi-host             -> ``jax.distributed.initialize`` over DCN +
+  ``dryrun_multihost`` (the 2-process CPU proof in tests/test_multihost.py).
 
 For objectives that are *not* jax-traceable (arbitrary Python fitness
 functions, classic gym rollouts), ``hostpool.HostEvaluatorPool`` provides the
 reference's actor-pool behavior with plain worker processes.
 """
 
-from .mesh import default_mesh, device_count, make_mesh
+from .mesh import (
+    MESH_AXES,
+    default_mesh,
+    device_count,
+    make_mesh,
+    mesh_label,
+    parse_mesh_shape,
+)
 from .evaluate import (
+    make_generation_step,
     make_sharded_evaluator,
     make_sharded_rollout_evaluator,
+    population_spec,
     shard_population,
 )
 from .grad import make_sharded_grad_estimator
 from .hostpool import HostEvaluatorPool
-from .distributed import init_distributed
+from .distributed import dryrun_multihost, init_distributed
 
 __all__ = [
+    "MESH_AXES",
     "default_mesh",
     "device_count",
     "make_mesh",
+    "mesh_label",
+    "parse_mesh_shape",
+    "make_generation_step",
     "make_sharded_evaluator",
     "make_sharded_rollout_evaluator",
+    "population_spec",
     "shard_population",
     "make_sharded_grad_estimator",
     "HostEvaluatorPool",
     "init_distributed",
+    "dryrun_multihost",
 ]

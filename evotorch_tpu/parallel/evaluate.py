@@ -2,39 +2,125 @@
 
 Replaces the reference's actor-pool fitness evaluation
 (``core.py:2573-2600``: split batch -> ``ActorPool.map_unordered`` ->
-scatter-back) with a single jitted ``shard_map``: the ``(N, L)`` population is
-sharded along the mesh's population axis, each device evaluates its rows
-locally, and the sharded result is reassembled by XLA — no pickling, no RPC.
+scatter-back) with GSPMD: the evaluation is written ONCE as the global
+program, the ``(N, L)`` population is pinned to the mesh's population layout
+with ``NamedSharding`` / ``with_sharding_constraint``, and XLA's SPMD
+partitioner inserts the collectives — no pickling, no RPC, and no hand-written
+per-shard wiring (the per-lane PRNG chains, the obs-stat delta psums and the
+counter collectives of the old ``shard_map`` path all become compiler
+business). The global program IS the single-device program, so sharded
+evaluation is bit-identical to unsharded at any mesh shape (1-D ``pop`` or
+2-D ``pop x model``), and popsizes that don't divide the mesh are padded
+with first-row copies and masked via the engine's ``num_valid`` contract
+(``docs/sharding.md``).
+
+The pre-GSPMD explicit ``shard_map`` path is kept behind
+``use_shard_map=True`` / ``EVOTORCH_SHARD_MAP=1`` (the compat knob for A/B
+measurement — ``BENCH_SPMD=ab`` in ``bench_multichip.py``; it keeps the old
+strict divisibility errors and per-shard cohort semantics).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import default_mesh
+from .mesh import default_mesh, mesh_label
 
-# compiled shard_map programs kept per (lowrank, popsize); matches the spirit
-# of vecrl's _ENGINE_CACHE_SIZE bound
+# compiled programs kept per (lowrank, popsize); matches the spirit of
+# vecrl's _ENGINE_CACHE_SIZE bound
 _EVALUATOR_CACHE_SIZE = 64
 
 __all__ = [
+    "make_generation_step",
     "make_sharded_evaluator",
     "make_sharded_rollout_evaluator",
+    "population_spec",
     "shard_population",
 ]
 
 
-def shard_population(values: jnp.ndarray, mesh: Optional[Mesh] = None, axis_name: str = "pop") -> jnp.ndarray:
+def _use_shard_map(flag: Optional[bool]) -> bool:
+    """Resolve the compat knob: explicit argument wins, else the
+    ``EVOTORCH_SHARD_MAP=1`` environment toggle (default GSPMD)."""
+    if flag is None:
+        return os.environ.get("EVOTORCH_SHARD_MAP", "0") == "1"
+    return bool(flag)
+
+
+def population_spec(mesh: Mesh) -> P:
+    """The canonical ``PartitionSpec`` of a population's leading axis: ALL
+    mesh axes flattened onto it — on a 2-D ``pop x model`` mesh the
+    population rows spread over the entire device grid (``P(("pop",
+    "model"))``), so every device holds whole lanes and the evaluation stays
+    bit-identical to the unsharded program (sharding model *parameters*
+    across lanes is a different layout with different numerics — see
+    docs/sharding.md)."""
+    names = tuple(mesh.axis_names)
+    return P(names) if len(names) > 1 else P(names[0])
+
+
+def shard_population(
+    values: jnp.ndarray, mesh: Optional[Mesh] = None, axis_name: Optional[str] = None
+) -> jnp.ndarray:
     """Place a population array so its leading (population) axis is sharded
-    over the mesh — rows live distributed in HBM across devices."""
+    over the mesh — rows live distributed in HBM across devices. With the
+    default ``axis_name=None`` the rows spread over ALL mesh axes
+    (``population_spec``); passing a name shards over just that axis (the
+    historical 1-D form)."""
     if mesh is None:
-        mesh = default_mesh((axis_name,))
-    return jax.device_put(values, NamedSharding(mesh, P(axis_name)))
+        mesh = default_mesh((axis_name,) if axis_name is not None else ("pop",))
+    spec = population_spec(mesh) if axis_name is None else P(axis_name)
+    return jax.device_put(values, NamedSharding(mesh, spec))
+
+
+def _mesh_grid_size(mesh: Mesh) -> int:
+    size = 1
+    for s in mesh.shape.values():
+        size *= int(s)
+    return size
+
+
+def _pad_rows(values, padded_n: int):
+    """Pad a population's leading axis to ``padded_n`` with copies of the
+    first row: always a VALID genome, so fitness functions undefined at
+    synthetic points (log/div at the zero vector) and jax_debug_nans stay
+    safe. Consumers mask the tail via ``num_valid`` or discard it."""
+    from ..tools.lowrank import LowRankParamsBatch
+
+    if isinstance(values, LowRankParamsBatch):
+        coeffs = values.coeffs
+        pad = jnp.broadcast_to(
+            coeffs[:1], (padded_n - coeffs.shape[0],) + coeffs.shape[1:]
+        )
+        return values._replace(coeffs=jnp.concatenate([coeffs, pad], axis=0))
+    pad = jnp.broadcast_to(values[:1], (padded_n - values.shape[0],) + values.shape[1:])
+    return jnp.concatenate([values, pad], axis=0)
+
+
+def _constrain_population(values, mesh: Mesh):
+    """Pin a (dense or factored) population to the mesh's population layout
+    inside a jitted program. Low-rank batches shard their per-lane
+    coefficients and replicate the shared center/basis (the factored analog
+    of ``vecrl._params_shard_spec``)."""
+    from ..tools.lowrank import LowRankParamsBatch
+
+    spec = population_spec(mesh)
+    if isinstance(values, LowRankParamsBatch):
+        rep = NamedSharding(mesh, P())
+        return LowRankParamsBatch(
+            center=jax.lax.with_sharding_constraint(values.center, rep),
+            basis=jax.lax.with_sharding_constraint(values.basis, rep),
+            coeffs=jax.lax.with_sharding_constraint(
+                values.coeffs, NamedSharding(mesh, spec)
+            ),
+        )
+    return jax.lax.with_sharding_constraint(values, NamedSharding(mesh, spec))
 
 
 def make_sharded_evaluator(
@@ -42,16 +128,42 @@ def make_sharded_evaluator(
     *,
     mesh: Optional[Mesh] = None,
     axis_name: str = "pop",
+    use_shard_map: Optional[bool] = None,
 ) -> Callable:
     """Wrap a vectorized fitness function ``f(values (n,L)) -> (n,) | (n,K)``
     into a jitted evaluator that shards the population axis over the mesh.
 
-    Populations whose size is not divisible by the mesh axis are padded with
+    Populations whose size is not divisible by the mesh are padded with
     their first row and the padding results are discarded (the analog of the
     reference's uneven ``split_workload``, ``tools/misc.py:1113``).
+
+    Default GSPMD: the function is traced once globally and the population is
+    pinned to ``population_spec(mesh)`` — XLA partitions the computation.
+    ``use_shard_map=True`` (or ``EVOTORCH_SHARD_MAP=1``) keeps the explicit
+    per-shard ``shard_map`` form.
     """
     if mesh is None:
         mesh = default_mesh((axis_name,))
+    if _use_shard_map(use_shard_map):
+        return _shard_map_evaluator(fitness_func, mesh=mesh, axis_name=axis_name)
+
+    n_grid = _mesh_grid_size(mesh)
+    sharding = NamedSharding(mesh, population_spec(mesh))
+
+    @jax.jit
+    def evaluator(values):
+        n = values.shape[0]
+        padded_n = -(-n // n_grid) * n_grid
+        padded = _pad_rows(values, padded_n) if padded_n != n else values
+        padded = jax.lax.with_sharding_constraint(padded, sharding)
+        result = fitness_func(padded)
+        return jax.tree_util.tree_map(lambda r: r[:n], result)
+
+    return evaluator
+
+
+def _shard_map_evaluator(fitness_func, *, mesh, axis_name):
+    """The pre-GSPMD explicit form (compat knob)."""
     n_shards = mesh.shape[axis_name]
 
     def local_eval(values_shard):
@@ -61,16 +173,7 @@ def make_sharded_evaluator(
     def evaluator(values):
         n = values.shape[0]
         padded_n = -(-n // n_shards) * n_shards
-        if padded_n != n:
-            # pad with copies of the first row: always a VALID genome, so
-            # fitness functions undefined at synthetic points (log/div at the
-            # zero vector) and jax_debug_nans stay safe; the padded results
-            # are discarded below
-            pad = jnp.broadcast_to(values[:1], (padded_n - n,) + values.shape[1:])
-            padded = jnp.concatenate([values, pad], axis=0)
-        else:
-            padded = values
-
+        padded = _pad_rows(values, padded_n) if padded_n != n else values
         out_struct = jax.eval_shape(fitness_func, padded)
         out_specs = jax.tree_util.tree_map(lambda _: P(axis_name), out_struct)
         result = jax.shard_map(
@@ -85,6 +188,65 @@ def make_sharded_evaluator(
     return evaluator
 
 
+_RESERVED_ROLLOUT_KWARGS = {"lane_ids", "stats_sync_axis", "seed_stride", "num_valid"}
+
+
+def _check_reserved(rollout_kwargs, what: str):
+    reserved = _RESERVED_ROLLOUT_KWARGS & set(rollout_kwargs)
+    if reserved:
+        raise ValueError(
+            f"{what} sets {sorted(reserved)} itself (the global lane/seed "
+            "wiring and the padding mask are what the helper exists to get "
+            "right) — drop them from the rollout kwargs"
+        )
+
+
+def _lookup_refill_config(env, policy, mesh, rollout_kwargs, popsize):
+    """Tuned-config cache consult (observability/timings.py) for a
+    refill-mode evaluation with no explicit knobs. Returns
+    ``(local_kwargs, source)``. Cache widths are GLOBAL lane counts; the
+    lookup shape carries the mesh label, so a schedule tuned at one mesh
+    shape is never applied under another (docs/observability.md)."""
+    from ..observability.timings import (
+        SOURCE_CACHE,
+        SOURCE_FALLBACK,
+        SOURCE_OVERRIDE,
+        canonical_env_label,
+        dtype_label,
+        lookup_tuned,
+    )
+
+    local_kwargs = dict(rollout_kwargs)
+    # GROUP-level override semantics, same as resolve_knobs everywhere else:
+    # ANY explicit refill knob (width OR period) disables the cache for the
+    # whole group — a cached width was measured at its cached period, so
+    # mixing it with a caller's period would be an unmeasured combination
+    # wearing a "cache" label
+    if (
+        rollout_kwargs.get("refill_width") is not None
+        or rollout_kwargs.get("refill_period") is not None
+    ):
+        return local_kwargs, SOURCE_OVERRIDE
+    entry = lookup_tuned(
+        "refill",
+        {
+            "env": canonical_env_label(env),
+            "popsize": popsize,
+            "episode_length": rollout_kwargs.get("episode_length"),
+            "num_episodes": rollout_kwargs.get("num_episodes", 1),
+            "params": policy.parameter_count,
+            "dtype": dtype_label(rollout_kwargs.get("compute_dtype")),
+            "mesh": mesh_label(mesh),
+        },
+    )
+    if entry is not None and entry.config.get("width") is not None:
+        local_kwargs["refill_width"] = int(entry.config["width"])
+        if entry.config.get("period") is not None:
+            local_kwargs["refill_period"] = int(entry.config["period"])
+        return local_kwargs, SOURCE_CACHE
+    return local_kwargs, SOURCE_FALLBACK
+
+
 def make_sharded_rollout_evaluator(
     env,
     policy,
@@ -92,44 +254,148 @@ def make_sharded_rollout_evaluator(
     mesh: Optional[Mesh] = None,
     axis_name: str = "pop",
     stats_sync: bool = False,
+    use_shard_map: Optional[bool] = None,
     **rollout_kwargs,
 ):
-    """Shard_map the monolithic rollout engine
-    (``neuroevolution.net.vecrl.run_vectorized_rollout``) over the mesh's
-    population axis — the reusable form of the sharded-evaluation recipe
-    (``dryrun_multichip`` calls it; ``VecNE._evaluate_all`` and
-    ``bench_multichip`` still carry historical inline copies of the same
-    wiring — keep the three in sync until they migrate here):
+    """Shard the monolithic rollout engine
+    (``neuroevolution.net.vecrl.run_vectorized_rollout``) over the mesh —
+    the reusable form of the sharded-evaluation recipe (``dryrun_multichip``
+    and ``VecNE._evaluate_all`` call it; ``bench_multichip`` carries the A/B
+    harness over both forms).
 
-    - per-lane PRNG chains seeded by GLOBAL lane ids with the same base key
-      on every shard (the mesh is an execution detail);
-    - per-shard work queues for ``eval_mode="episodes_refill"``
-      (``seed_stride`` is forced to the global popsize so (solution, episode)
-      seeds stay unique across shards, and ``refill_width`` is GLOBAL —
-      divided across the mesh like every other surface of the knob
-      (``VecNE`` ``refill_config['width']``, ``BENCH_REFILL_WIDTH``) —
-      so the same value means the same total lane count at any mesh size.
-      This helper is the strict surface: it raises on a width not divisible
-      by the mesh axis size, while the convenience knobs floor per shard
-      like compact_config's widths. With NO explicit width, the tuned-config
-      cache (``observability/timings.py``) is consulted per popsize — the
-      autotuner's measured winner for this (env, popsize, episode length/count, params, dtype, machine) — and
-      ``evaluator.tuned_config_source`` reports the branch taken:
-      override / cache / fallback);
-    - obs-norm statistics merged with a psum — per-step deltas when
-      ``stats_sync=True`` (mesh-global cohort), else one end-of-rollout delta
-      merge (shard-local cohorts, the reference's per-actor semantics);
-    - step/episode counters psum'd, per-shard counted steps returned;
-    - the packed observability telemetry vector psum'd to its mesh-global
-      form (all slots additive — ``observability.devicemetrics``), returned
-      in ``RolloutResult.telemetry``.
+    Default GSPMD: the GLOBAL rollout program is jitted once, the population
+    pinned to ``population_spec(mesh)`` (all mesh axes flattened over the
+    population rows), and XLA partitions the loop — the program IS the
+    unsharded program, so scores are bit-identical to single-device at any
+    mesh shape, the obs-norm cohort is always the mesh-GLOBAL population
+    (``stats_sync`` is moot here: per-shard cohorts were an artifact of the
+    explicit per-shard wiring), and popsizes that don't divide the mesh are
+    padded with first-row copies whose lanes are masked out of score credit
+    and every counter/telemetry slot via the engine's ``num_valid`` contract.
+
+    ``use_shard_map=True`` (or ``EVOTORCH_SHARD_MAP=1``) selects the
+    pre-GSPMD explicit path: per-shard ``run_vectorized_rollout`` calls with
+    GLOBAL lane ids, psum'd stat deltas/counters/telemetry, per-shard refill
+    queues (``refill_width`` divided across the 1-D mesh; raises when an
+    explicit width is not divisible), ``stats_sync`` selecting per-step vs
+    end-of-rollout stat merges, and strict popsize divisibility.
+
+    Refill evaluations with NO explicit knobs consult the tuned-config cache
+    (``observability/timings.py``) per popsize — the autotuner's measured
+    winner for this (env, popsize, episode length/count, params, dtype,
+    mesh label, machine) — and ``evaluator.tuned_config_source`` reports the
+    branch taken: override / cache / fallback.
 
     Accepts dense ``(N, L)`` populations and factored
     ``LowRankParamsBatch``es (coefficients shard; center/basis replicate).
     Returns ``evaluator(values, key, stats) -> (RolloutResult,
     per_shard_steps)``.
     """
+    _check_reserved(rollout_kwargs, "make_sharded_rollout_evaluator")
+    if mesh is None:
+        mesh = default_mesh((axis_name,))
+    if _use_shard_map(use_shard_map):
+        return _shard_map_rollout_evaluator(
+            env,
+            policy,
+            mesh=mesh,
+            axis_name=axis_name,
+            stats_sync=stats_sync,
+            **rollout_kwargs,
+        )
+
     # imported lazily: parallel.* must stay importable before neuroevolution
+    from ..neuroevolution.net.vecrl import (
+        _params_popsize,
+        run_vectorized_rollout,
+        RolloutResult,
+    )
+    from ..tools.lowrank import LowRankParamsBatch
+
+    n_grid = _mesh_grid_size(mesh)
+    refill_mode = rollout_kwargs.get("eval_mode") == "episodes_refill"
+
+    def build(lowrank: bool, popsize: int):
+        local_kwargs = dict(rollout_kwargs)
+        source = None
+        if refill_mode:
+            local_kwargs, source = _lookup_refill_config(
+                env, policy, mesh, rollout_kwargs, popsize
+            )
+        padded_n = -(-popsize // n_grid) * n_grid
+        num_valid = popsize if padded_n != popsize else None
+
+        def global_eval(values, key, stats):
+            if padded_n != popsize:
+                values = _pad_rows(values, padded_n)
+            values = _constrain_population(values, mesh)
+            result = run_vectorized_rollout(
+                env,
+                policy,
+                values,
+                key,
+                stats,
+                num_valid=num_valid,
+                **local_kwargs,
+            )
+            if result.telemetry is None:
+                telemetry = jnp.zeros((0,), dtype=jnp.int32)
+            else:
+                telemetry = result.telemetry  # the global program's counters
+            return (
+                result.scores[:popsize],
+                result.stats,
+                result.total_steps,
+                result.total_episodes,
+                # GSPMD has no per-shard accounting (XLA owns the layout);
+                # the 1-element form keeps the (result, per_shard) contract
+                result.total_steps[None],
+                telemetry,
+            )
+
+        return jax.jit(global_eval), source
+
+    # bounded LRU like vecrl's engine caches: an adaptive-popsize caller
+    # compiles one program per distinct popsize, and compiled executables
+    # must not accumulate without bound over a long run
+    build = functools.lru_cache(maxsize=_EVALUATOR_CACHE_SIZE)(build)
+
+    def evaluator(values, key, stats):
+        lowrank = isinstance(values, LowRankParamsBatch)
+        popsize = _params_popsize(values)
+        fn, source = build(lowrank, popsize)
+        evaluator.tuned_config_source = source
+        scores, merged, steps, episodes, per_shard, telemetry = fn(values, key, stats)
+        result = RolloutResult(
+            scores=scores,
+            stats=merged,
+            total_steps=steps,
+            total_episodes=episodes,
+            telemetry=telemetry if telemetry.size else None,
+        )
+        return result, per_shard
+
+    # the jitted (lowrank, popsize) -> program factory, exposed so the
+    # program ledger can AOT-lower the exact executable the evaluator
+    # dispatches (observability/inventory.py)
+    evaluator.program_builder = lambda lowrank, popsize: build(lowrank, popsize)[0]
+    # provenance of the LAST dispatched popsize's refill knobs ("override" /
+    # "cache" / "fallback"; None before the first refill-mode dispatch)
+    evaluator.tuned_config_source = None
+    return evaluator
+
+
+def _shard_map_rollout_evaluator(
+    env,
+    policy,
+    *,
+    mesh,
+    axis_name: str = "pop",
+    stats_sync: bool = False,
+    **rollout_kwargs,
+):
+    """The pre-GSPMD explicit shard_map path (compat knob; see
+    ``make_sharded_rollout_evaluator``)."""
     from ..neuroevolution.net.vecrl import (
         _params_popsize,
         _params_shard_spec,
@@ -139,26 +405,7 @@ def make_sharded_rollout_evaluator(
     )
     from ..tools.lowrank import LowRankParamsBatch
 
-    reserved = {"lane_ids", "stats_sync_axis", "seed_stride"} & set(rollout_kwargs)
-    if reserved:
-        raise ValueError(
-            f"make_sharded_rollout_evaluator sets {sorted(reserved)} itself "
-            "(global lane ids, the stats_sync/axis wiring, and the global "
-            "seed stride are what the helper exists to get right) — drop "
-            "them from the rollout kwargs"
-        )
-    if mesh is None:
-        mesh = default_mesh((axis_name,))
     refill_mode = rollout_kwargs.get("eval_mode") == "episodes_refill"
-    # GROUP-level override semantics, same as resolve_knobs everywhere
-    # else: ANY explicit refill knob (width OR period) disables the cache
-    # for the whole group — a cached width was measured at its cached
-    # period, so mixing it with a caller's period would be an unmeasured
-    # combination wearing a "cache" label
-    explicit_refill = refill_mode and (
-        rollout_kwargs.get("refill_width") is not None
-        or rollout_kwargs.get("refill_period") is not None
-    )
     if refill_mode and rollout_kwargs.get("refill_width") is not None:
         width = int(rollout_kwargs["refill_width"])
         n_shards = mesh.shape[axis_name]
@@ -170,48 +417,22 @@ def make_sharded_rollout_evaluator(
         rollout_kwargs["refill_width"] = width // n_shards
 
     def build(lowrank: bool, popsize: int):
-        # tuned-config cache (observability/timings.py): a refill
-        # evaluation with NO explicit width consults the checked-in
-        # tuned_configs.json for this (env, popsize, episode length/count, params, dtype, machine) — cache
-        # widths are GLOBAL, divided per shard with the convenience-knob
-        # flooring (only an explicit width gets the strict divisibility
-        # check above). Provenance: `evaluator.tuned_config_source`.
+        # tuned-config cache: cache widths are GLOBAL, divided per shard with
+        # the convenience-knob flooring (only an explicit width gets the
+        # strict divisibility check above)
         local_kwargs = dict(rollout_kwargs)
         source = None
         if refill_mode:
-            from ..observability.timings import (
-                SOURCE_CACHE,
-                SOURCE_FALLBACK,
-                SOURCE_OVERRIDE,
-                canonical_env_label,
-                dtype_label,
-                lookup_tuned,
+            local_kwargs, source = _lookup_refill_config(
+                env, policy, mesh, rollout_kwargs, popsize
             )
+            from ..observability.timings import SOURCE_CACHE
 
-            if explicit_refill:
-                source = SOURCE_OVERRIDE
-            else:
-                entry = lookup_tuned(
-                    "refill",
-                    {
-                        "env": canonical_env_label(env),
-                        "popsize": popsize,
-                        "episode_length": rollout_kwargs.get("episode_length"),
-                        "num_episodes": rollout_kwargs.get("num_episodes", 1),
-                        "params": policy.parameter_count,
-                        "dtype": dtype_label(rollout_kwargs.get("compute_dtype")),
-                    },
+            if source == SOURCE_CACHE:
+                n_shards = mesh.shape[axis_name]
+                local_kwargs["refill_width"] = max(
+                    1, int(local_kwargs["refill_width"]) // n_shards
                 )
-                if entry is not None and entry.config.get("width") is not None:
-                    n_shards = mesh.shape[axis_name]
-                    local_kwargs["refill_width"] = max(
-                        1, int(entry.config["width"]) // n_shards
-                    )
-                    if entry.config.get("period") is not None:
-                        local_kwargs["refill_period"] = int(entry.config["period"])
-                    source = SOURCE_CACHE
-                else:
-                    source = SOURCE_FALLBACK
 
         def local(values_shard, key, stats):
             result = run_vectorized_rollout(
@@ -261,9 +482,6 @@ def make_sharded_rollout_evaluator(
         )
         return fn, source
 
-    # bounded LRU like vecrl's engine caches: an adaptive-popsize caller
-    # compiles one shard_map program per distinct popsize, and compiled
-    # executables must not accumulate without bound over a long run
     build = functools.lru_cache(maxsize=_EVALUATOR_CACHE_SIZE)(build)
 
     def evaluator(values, key, stats):
@@ -281,11 +499,72 @@ def make_sharded_rollout_evaluator(
         )
         return result, per_shard
 
-    # the jitted (lowrank, popsize) -> shard_map program factory, exposed so
-    # the program ledger can AOT-lower the exact executable the evaluator
-    # dispatches (observability/inventory.py)
     evaluator.program_builder = lambda lowrank, popsize: build(lowrank, popsize)[0]
-    # provenance of the LAST dispatched popsize's refill knobs ("override" /
-    # "cache" / "fallback"; None before the first refill-mode dispatch)
     evaluator.tuned_config_source = None
     return evaluator
+
+
+def make_generation_step(
+    env,
+    policy,
+    *,
+    ask: Callable,
+    tell: Callable,
+    popsize: int,
+    mesh: Optional[Mesh] = None,
+    donate_state: bool = True,
+    **rollout_kwargs,
+):
+    """One whole generation — ``ask -> sharded rollout -> tell`` — compiled
+    as ONE jitted GSPMD program with the evolution state DONATED: the
+    sample buffers, the rollout working set and the updated distribution
+    state all reuse the previous generation's HBM, so a training loop's
+    steady-state footprint is a single generation's live set (the program
+    ledger's donation verification covers this program;
+    ``docs/observability.md``).
+
+    ``ask(key, state) -> values`` samples the ``(popsize, L)`` population
+    (dense or ``LowRankParamsBatch``); ``tell(state, values, scores) ->
+    state`` applies the update. Both run INSIDE the program — the population
+    is born on its shards, evaluated in place, and consumed by the update
+    without ever leaving the device grid.
+
+    Returns ``generation(state, key, stats) -> (state, scores, stats,
+    total_steps, telemetry)``. With ``donate_state=True`` (default) the
+    caller must rebind: ``state, ... = generation(state, key, stats)`` —
+    the old state's buffers are invalidated.
+    """
+    from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+    _check_reserved(rollout_kwargs, "make_generation_step")
+    if mesh is None:
+        mesh = default_mesh(("pop",))
+    popsize = int(popsize)
+    n_grid = _mesh_grid_size(mesh)
+    padded_n = -(-popsize // n_grid) * n_grid
+    num_valid = popsize if padded_n != popsize else None
+
+    def generation(state, key, stats):
+        k_ask, k_eval = jax.random.split(key)
+        values = ask(k_ask, state)
+        evald = _pad_rows(values, padded_n) if padded_n != popsize else values
+        evald = _constrain_population(evald, mesh)
+        result = run_vectorized_rollout(
+            env,
+            policy,
+            evald,
+            k_eval,
+            stats,
+            num_valid=num_valid,
+            **rollout_kwargs,
+        )
+        scores = result.scores[:popsize]
+        new_state = tell(state, values, scores)
+        telemetry = (
+            jnp.zeros((0,), dtype=jnp.int32)
+            if result.telemetry is None
+            else result.telemetry
+        )
+        return new_state, scores, result.stats, result.total_steps, telemetry
+
+    return jax.jit(generation, donate_argnums=(0,) if donate_state else ())

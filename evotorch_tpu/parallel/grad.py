@@ -1,14 +1,21 @@
 """Sharded ES-gradient estimation: the TPU form of the reference's
 distributed mode.
 
-Reference behavior (``core.py:2762-3073`` + ``gaussian.py:199-272``): each Ray
-actor samples its own sub-population from the (broadcast) distribution,
-evaluates it, ranks *locally*, computes local gradients, and the main process
-averages the per-actor gradients weighted by sub-population size. Here the
-same dataflow is one SPMD program: each mesh shard samples ``popsize/shards``
-solutions with a device-unique key, evaluates and ranks locally, computes
-local gradients, and a ``pmean`` over the population axis produces the
-(equal-weight, since shards are equal-sized) average on every device.
+Default GSPMD: the sample/evaluate/rank/grad pipeline is written ONCE as the
+global program — sample the full population, rank GLOBALLY, compute the
+gradients — with the sample matrix pinned to the mesh's population layout;
+XLA partitions the math and inserts the reductions. Global ranking is the
+reference's SINGLE-PROCESS semantics (``gaussian.py:199-272`` without the
+actor split), so the estimate is exactly what a one-device run computes, at
+any mesh shape and ANY population size (no divisibility constraint — GSPMD
+handles uneven layouts).
+
+``use_shard_map=True`` / ``EVOTORCH_SHARD_MAP=1`` keeps the pre-GSPMD
+explicit form, which reproduces the reference's DISTRIBUTED-mode semantics
+(``core.py:2762-3073``): each shard samples its own sub-population with a
+device-unique key, ranks *locally*, computes local gradients, and a ``pmean``
+averages them — per-actor local ranking is a semantic, not just a layout
+(rank weights depend on the cohort), which is why the knob preserves it.
 """
 
 from __future__ import annotations
@@ -17,10 +24,11 @@ from typing import Callable, Optional, Type
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..tools.lowrank import dense_values
 from ..tools.ranking import rank
+from .evaluate import _use_shard_map, population_spec
 from .mesh import default_mesh
 
 __all__ = ["make_sharded_grad_estimator"]
@@ -36,36 +44,74 @@ def make_sharded_grad_estimator(
     axis_name: str = "pop",
     with_aux: bool = False,
     lowrank_rank: Optional[int] = None,
+    use_shard_map: Optional[bool] = None,
 ) -> Callable:
     """Build ``g(key, num_solutions, parameters) -> grads`` where the
     sample/evaluate/rank/grad pipeline runs sharded over the mesh and the
-    returned gradient dict is the pmean across shards (replicated on all
-    devices).
+    returned gradient dict is replicated on all devices.
 
-    ``num_solutions`` is the *global* population size and must be divisible by
-    the mesh axis size (and the local size must be even for symmetric
-    distributions).
+    Default GSPMD (global ranking = the reference's single-process
+    semantics): ``num_solutions`` may be ANY size. Under the
+    ``use_shard_map`` compat knob (the reference's distributed per-actor
+    local-ranking semantics) it must be divisible by the mesh axis size (and
+    the local size even for symmetric distributions).
 
     With ``with_aux=True`` the estimator returns ``(grads, aux)`` where
-    ``aux["mean_eval"]`` is the population-mean fitness (the pmean of the
-    shard-local means — what the reference's main process reconstructs from
-    the per-actor ``mean_eval`` entries, ``gaussian.py:246-272``).
+    ``aux["mean_eval"]`` is the population-mean fitness (what the
+    reference's main process reconstructs from the per-actor ``mean_eval``
+    entries, ``gaussian.py:246-272``).
 
-    With ``lowrank_rank`` each shard samples its own factored (low-rank)
-    sub-population — per-shard basis, the analog of per-actor independent
-    sampling — and computes its gradients from the factors in O(L * rank);
-    only the fitness evaluation materializes the dense shard-local matrix
-    (plain fitness functions consume dense rows)."""
+    With ``lowrank_rank`` the population is sampled in factored (low-rank)
+    form and the gradients come from the factors in O(L * rank); only the
+    fitness evaluation materializes the dense matrix (plain fitness
+    functions consume dense rows). Under the compat knob each shard samples
+    its own basis (per-actor independent sampling)."""
     if mesh is None:
         mesh = default_mesh((axis_name,))
-    n_shards = mesh.shape[axis_name]
     higher_is_better = {"max": True, "min": False}[objective_sense]
+    legacy = _use_shard_map(use_shard_map)
+    n_shards = mesh.shape[axis_name] if legacy else None
+    pop_sharding = NamedSharding(mesh, population_spec(mesh))
 
-    # one jitted shard_map program per (local popsize, static params): repeated
-    # calls must hit JAX's dispatch cache instead of retracing every generation
+    # one jitted program per (popsize, static params): repeated calls must
+    # hit JAX's dispatch cache instead of retracing every generation
     compiled: dict = {}
 
-    def _build(local_popsize: int, static_items: tuple):
+    def _build_global(num_solutions: int, static_items: tuple):
+        static_params = dict(static_items)
+
+        def fn(key, array_params):
+            parameters = {**array_params, **static_params}
+            if lowrank_rank is not None:
+                samples = distribution_class._sample_lowrank(
+                    key, parameters, num_solutions, lowrank_rank
+                )
+                samples = samples._replace(
+                    coeffs=jax.lax.with_sharding_constraint(
+                        samples.coeffs, pop_sharding
+                    )
+                )
+                fitnesses = fitness_func(dense_values(samples))
+            else:
+                samples = distribution_class._sample(key, parameters, num_solutions)
+                samples = jax.lax.with_sharding_constraint(samples, pop_sharding)
+                fitnesses = fitness_func(samples)
+            weights = rank(fitnesses, ranking_method, higher_is_better=higher_is_better)
+            grads = distribution_class._compute_gradients(
+                parameters, samples, weights, ranking_method
+            )
+            if with_aux:
+                aux = {"mean_eval": jnp.mean(fitnesses)}
+                if lowrank_rank is not None:
+                    # the global basis, for the caller's subspace-exhaustion
+                    # diagnostic (basis_capture)
+                    aux["basis"] = samples.basis
+                return grads, aux
+            return grads
+
+        return jax.jit(fn)
+
+    def _build_shard_map(local_popsize: int, static_items: tuple):
         static_params = dict(static_items)
 
         def local(key, array_params):
@@ -112,11 +158,14 @@ def make_sharded_grad_estimator(
 
     def estimator(key, num_solutions: int, parameters: dict):
         num_solutions = int(num_solutions)
-        if num_solutions % n_shards != 0:
-            raise ValueError(
-                f"num_solutions={num_solutions} must be divisible by the mesh axis size {n_shards}"
-            )
-        local_popsize = num_solutions // n_shards
+        if legacy:
+            if num_solutions % n_shards != 0:
+                raise ValueError(
+                    f"num_solutions={num_solutions} must be divisible by the mesh axis size {n_shards}"
+                )
+            build_size = num_solutions // n_shards
+        else:
+            build_size = num_solutions
 
         # strings ("divide_mu_grad_by", ...) and structural floats
         # ("parenthood_ratio") are not JAX types: close over them statically
@@ -127,10 +176,11 @@ def make_sharded_grad_estimator(
         }
         array_params = {k: v for k, v in parameters.items() if k not in static_params}
 
-        cache_key = (local_popsize, tuple(sorted(static_params.items())))
+        cache_key = (build_size, tuple(sorted(static_params.items())))
         fn = compiled.get(cache_key)
         if fn is None:
-            fn = compiled[cache_key] = _build(local_popsize, cache_key[1])
+            builder = _build_shard_map if legacy else _build_global
+            fn = compiled[cache_key] = builder(build_size, cache_key[1])
         return fn(key, array_params)
 
     return estimator

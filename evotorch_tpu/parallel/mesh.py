@@ -3,18 +3,33 @@
 The mesh is the TPU analog of the reference's actor pool size
 (``num_actors``, reference ``core.py:1302-1595``): instead of asking "how many
 Ray actors", you ask "which mesh axes". The default is a 1-D mesh named
-``"pop"`` over all local devices, used to shard the population axis.
+``"pop"`` over all local devices, used to shard the population axis; 2-D
+``pop x model`` meshes add a model axis for sharding wide-policy parameters
+(docs/sharding.md).
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["default_mesh", "make_mesh", "device_count"]
+__all__ = [
+    "MESH_AXES",
+    "default_mesh",
+    "device_count",
+    "make_mesh",
+    "mesh_label",
+    "parse_mesh_shape",
+]
+
+#: the named mesh axes of the parallel layer (docs/sharding.md): ``"pop"``
+#: shards the population axis, ``"model"`` shards model parameters (wide
+#: policies) — graftlint's axis-name checker validates collective /
+#: PartitionSpec string literals against this declaration
+MESH_AXES = ("pop", "model")
 
 
 def device_count() -> int:
@@ -43,3 +58,50 @@ def make_mesh(axis_shape: dict, devices=None) -> Mesh:
         raise ValueError(f"Mesh needs {total} devices, but only {len(devices)} are available")
     grid = np.asarray(devices[:total]).reshape(shape)
     return Mesh(grid, axis_names=names)
+
+
+def mesh_label(mesh: Optional[Mesh]) -> str:
+    """The canonical mesh-shape label used in timing-ledger / tuned-config
+    cache keys (``observability.timings``): ``"none"`` for an unsharded
+    evaluation, ``"pop8"`` for a 1-D 8-way pop mesh, ``"pop4.model2"`` for a
+    2-D mesh, with a ``"hosts{n}."`` prefix under multi-host
+    (``jax.distributed``). Size-1 axes are dropped — a ``(8, 1)``
+    ``pop x model`` mesh lays out identically to a 1-D ``pop`` 8-mesh, so
+    measurements transfer — and an all-1 mesh IS the unsharded layout
+    (``"none"``). A schedule tuned at one label is never applied under
+    another (ISSUE 13 satellite; a width tuned on the 1-D 8-mesh says
+    nothing about a 2-D or multi-host layout)."""
+    if mesh is None:
+        return "none"
+    parts = [f"{name}{size}" for name, size in mesh.shape.items() if int(size) > 1]
+    label = ".".join(parts) if parts else "none"
+    n_hosts = jax.process_count()
+    if n_hosts > 1:
+        label = f"hosts{n_hosts}.{label}"
+    return label
+
+
+def parse_mesh_shape(spec) -> dict:
+    """Parse a mesh-shape knob (``BENCH_MESH``) into ``{axis: size}``:
+
+    - ``"8"`` / ``8``      -> ``{"pop": 8}`` (the historical 1-D form)
+    - ``"4x2"``            -> ``{"pop": 4, "model": 2}``
+    - ``"pop=4,model=2"``  -> ``{"pop": 4, "model": 2}`` (explicit names)
+    """
+    if isinstance(spec, int):
+        return {"pop": int(spec)}
+    text = str(spec).strip()
+    if "=" in text:
+        out = {}
+        for part in text.split(","):
+            name, _, size = part.partition("=")
+            out[name.strip()] = int(size)
+        return out
+    if "x" in text:
+        sizes = [int(p) for p in text.split("x")]
+        if len(sizes) > len(MESH_AXES):
+            raise ValueError(
+                f"mesh shape {text!r} has {len(sizes)} axes; named axes are {MESH_AXES}"
+            )
+        return {name: size for name, size in zip(MESH_AXES, sizes)}
+    return {"pop": int(text)}

@@ -83,6 +83,13 @@ run autotune 2400 env BENCH_BF16=1 python -m evotorch_tpu.observability.autotune
 # 4. sharded bench on the single real chip (mesh of 1; exercise the path)
 run bench_multichip 1800 python bench_multichip.py
 
+# 4a. GSPMD vs shard_map A/B on the same chip (BENCH_SPMD=ab: interleaved
+#     median-of-3 samples each, spmd_speedup on the JSON line) — the
+#     acceptance measurement of the named-sharding rewrite on real hardware
+#     (docs/sharding.md); own stamp so a tunnel drop here doesn't re-run
+#     the whole sharded step on resume
+run sharded_bench 2400 env BENCH_SPMD=ab python bench_multichip.py
+
 # 4b. program-ledger snapshot at FLAGSHIP shape on the real chip: compile
 #     wall-time, cost-model FLOPs and analyzed peak HBM of every registered
 #     program (one JSON line; compile-only, no timed rollouts) — the

@@ -502,7 +502,8 @@ def test_tune_group_withholds_floor_failing_winner_from_cache(
 
 def _stub_shape() -> dict:
     # matches _StubHarness's TuneShape defaults (episode_length 100, one
-    # episode) + its stub policy
+    # episode) + its stub policy; the autotuner measures unsharded, so its
+    # saved entries carry the "none" mesh label (ISSUE-13 schema v2)
     return {
         "env": "cartpole",
         "popsize": 8,
@@ -510,6 +511,7 @@ def _stub_shape() -> dict:
         "num_episodes": 1,
         "params": 7,
         "dtype": "float32",
+        "mesh": "none",
     }
 
 
@@ -564,6 +566,8 @@ def test_sharded_evaluator_consults_cache_per_popsize(tuned_cache, monkeypatch):
     env = CartPole()
     policy = FlatParamsPolicy(Linear(env.observation_size, env.action_size) >> Tanh())
     mesh = Mesh(np.asarray(jax.devices()[:2]), axis_names=("pop",))
+    # sharded lookups are mesh-scoped (ISSUE 13): the fixture's unsharded
+    # entry must NOT serve this pop2 evaluation, so seed the pop2 entry
     evaluator = make_sharded_rollout_evaluator(
         env, policy, mesh=mesh,
         num_episodes=1, episode_length=8, eval_mode="episodes_refill",
@@ -572,8 +576,25 @@ def test_sharded_evaluator_consults_cache_per_popsize(tuned_cache, monkeypatch):
     stats = RunningNorm(env.observation_size).stats
     params = jax.random.normal(jax.random.key(0), (8, policy.parameter_count))
     result, _ = evaluator(params, jax.random.key(1), stats)
+    # the fixture entry was tuned UNSHARDED — a pop2 mesh never inherits it
+    assert evaluator.tuned_config_source == "fallback"
+
+    save_tuned_entry(
+        TunedEntry(
+            group="refill",
+            shape=dict(_cartpole_shape(), mesh="pop2"),
+            machine=machine_fingerprint(),
+            config={"width": 4, "period": 1},
+            evidence={"steps_per_sec": 1.0},
+        )
+    )
+    evaluator = make_sharded_rollout_evaluator(
+        env, policy, mesh=mesh,
+        num_episodes=1, episode_length=8, eval_mode="episodes_refill",
+    )
+    result, _ = evaluator(params, jax.random.key(1), stats)
     assert evaluator.tuned_config_source == "cache"
-    # global width 4 over 2 shards -> 2 lanes per shard, 4 mesh-wide
+    # GSPMD: the cached width is GLOBAL and applies undivided (4 mesh-wide)
     assert EvalTelemetry.from_array(result.telemetry).lane_width == 4
 
     explicit = make_sharded_rollout_evaluator(

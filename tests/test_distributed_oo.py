@@ -1,11 +1,14 @@
-"""OO ``distributed=True`` semantics: shard-local ranking.
+"""OO ``distributed=True`` semantics under both SPMD forms.
 
 The reference's distributed mode (``core.py:3156-3301`` +
 ``algorithms/distributed/gaussian.py:199-272``) has each actor sample its own
 sub-population, rank **locally**, and compute local gradients; the main
-process averages them. These tests pin the TPU build to those exact
-statistics: ``Problem.sample_and_compute_gradients`` must rank per mesh shard
-(not globally) whenever a sharded evaluator is active.
+process averages them. After the GSPMD rewrite those exact statistics live
+behind the ``EVOTORCH_SHARD_MAP=1`` compat knob (local ranking is a
+*semantic*, not a layout — rank weights depend on the cohort), and the
+default is the reference's SINGLE-process semantics: one global program,
+global key, global ranking, identical at any mesh shape. Both are pinned
+here.
 """
 
 import jax
@@ -57,7 +60,30 @@ def _local_ranking_oracle(key, params, popsize, n_shards):
     return avg, jnp.concatenate(all_samples), jnp.concatenate(all_fits)
 
 
-def test_distributed_gradients_rank_locally():
+def test_distributed_gradients_gspmd_ranks_globally():
+    # the GSPMD default: global key, global ranking — the estimate is
+    # exactly what a one-device run computes, at any mesh shape
+    p = _make_problem(num_actors="max")
+    dist = SymmetricSeparableGaussian(_dist_params())
+    key = jax.random.key(123)
+    results = p.sample_and_compute_gradients(dist, 16, ranking_method="centered", key=key)
+    assert len(results) == 1
+    got = results[0]
+    assert got["num_solutions"] == 16
+
+    samples = SymmetricSeparableGaussian._sample(key, _dist_params(), 16)
+    fits = sphere(samples)
+    weights = rank(fits, "centered", higher_is_better=False)
+    oracle = SymmetricSeparableGaussian._compute_gradients(
+        _dist_params(), samples, weights, "centered"
+    )
+    for k in ("mu", "sigma"):
+        assert np.allclose(np.asarray(got["gradients"][k]), np.asarray(oracle[k]), atol=1e-5), k
+    assert np.isclose(got["mean_eval"], float(jnp.mean(fits)), atol=1e-4)
+
+
+def test_distributed_gradients_rank_locally(monkeypatch):
+    monkeypatch.setenv("EVOTORCH_SHARD_MAP", "1")
     p = _make_problem(num_actors="max")
     dist = SymmetricSeparableGaussian(_dist_params())
     key = jax.random.key(123)

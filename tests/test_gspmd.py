@@ -1,0 +1,395 @@
+"""GSPMD named-sharding rewrite (docs/sharding.md): bit-identity, padding,
+the one-program donated generation step, mesh-scoped tuned-cache keys, and
+the persistent compile cache.
+
+The load-bearing claim of the rewrite is that the mesh is an EXECUTION
+DETAIL: the global program is the single-device program, so sharded scores
+and counters are bit-identical to unsharded at any mesh shape, and popsizes
+that don't divide the device grid are padded + masked without touching the
+numbers. These tests pin that contract on the pytest 8-virtual-device CPU
+mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from evotorch_tpu.envs import CartPole
+from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+from evotorch_tpu.neuroevolution.net.vecrl import (
+    run_vectorized_rollout,
+    run_vectorized_rollout_compacting_sharded,
+)
+from evotorch_tpu.parallel import (
+    make_generation_step,
+    make_mesh,
+    make_sharded_rollout_evaluator,
+    mesh_label,
+    parse_mesh_shape,
+)
+from evotorch_tpu.observability import EvalTelemetry
+
+
+@pytest.fixture(scope="module")
+def cartpole_setup():
+    env = CartPole()
+    policy = FlatParamsPolicy(
+        Linear(env.observation_size, 4) >> Tanh() >> Linear(4, env.action_size)
+    )
+    stats = RunningNorm(env.observation_size).stats
+    return env, policy, stats
+
+
+def _population(policy, popsize, seed=0):
+    return 0.1 * jax.random.normal(
+        jax.random.key(seed), (popsize, policy.parameter_count)
+    )
+
+
+# ---------------------------------------------------------------------------
+# mesh helpers
+# ---------------------------------------------------------------------------
+
+
+def test_parse_mesh_shape_forms():
+    assert parse_mesh_shape("8") == {"pop": 8}
+    assert parse_mesh_shape(8) == {"pop": 8}
+    assert parse_mesh_shape("4x2") == {"pop": 4, "model": 2}
+    assert parse_mesh_shape("pop=4,model=2") == {"pop": 4, "model": 2}
+    with pytest.raises(ValueError):
+        parse_mesh_shape("2x2x2")  # more axes than MESH_AXES names
+
+
+def test_mesh_label_canonical_forms():
+    assert mesh_label(None) == "none"
+    assert mesh_label(make_mesh({"pop": 8})) == "pop8"
+    assert mesh_label(make_mesh({"pop": 4, "model": 2})) == "pop4.model2"
+    # size-1 axes drop: an (8, 1) mesh lays out like the 1-D 8-mesh
+    assert mesh_label(make_mesh({"pop": 8, "model": 1})) == "pop8"
+    assert mesh_label(make_mesh({"pop": 1, "model": 1})) == "none"
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: the global program IS the single-device program
+# ---------------------------------------------------------------------------
+
+# explicit refill knobs so the sharded and unsharded runs cannot diverge
+# through the tuned-config cache (override provenance on both sides)
+_MODE_KWARGS = {
+    "budget": {},
+    "episodes": {},
+    "episodes_refill": {"refill_width": 4, "refill_period": 1},
+}
+
+
+@pytest.mark.parametrize("eval_mode", sorted(_MODE_KWARGS))
+def test_gspmd_bit_identity_2d_mesh(cartpole_setup, eval_mode):
+    env, policy, stats = cartpole_setup
+    values = _population(policy, 16)
+    key = jax.random.key(3)
+    kwargs = dict(
+        num_episodes=1, episode_length=8, eval_mode=eval_mode,
+        **_MODE_KWARGS[eval_mode],
+    )
+
+    ref = run_vectorized_rollout(env, policy, values, key, stats, **kwargs)
+    ev = make_sharded_rollout_evaluator(
+        env, policy, mesh=make_mesh({"pop": 4, "model": 2}), **kwargs
+    )
+    result, per_shard = ev(values, key, stats)
+
+    np.testing.assert_array_equal(np.asarray(result.scores), np.asarray(ref.scores))
+    assert int(result.total_steps) == int(ref.total_steps)
+    assert int(result.total_episodes) == int(ref.total_episodes)
+    # GSPMD has no per-shard accounting: the 1-element form carries the total
+    assert np.asarray(per_shard).shape == (1,)
+    assert int(np.asarray(per_shard)[0]) == int(ref.total_steps)
+
+
+def test_compacting_sharded_bit_identity_2d_mesh(cartpole_setup):
+    # the fourth contract: host-chunked lane compaction, sharded over the
+    # pop axis of the same 2-D mesh (the model axis replicates)
+    env, policy, stats = cartpole_setup
+    values = _population(policy, 16)
+    key = jax.random.key(3)
+    ref = run_vectorized_rollout(
+        env, policy, values, key, stats,
+        num_episodes=1, episode_length=8, eval_mode="episodes",
+    )
+    result = run_vectorized_rollout_compacting_sharded(
+        env, policy, values, key, stats,
+        mesh=make_mesh({"pop": 4, "model": 2}),
+        num_episodes=1, episode_length=8, chunk_size=4,
+    )
+    np.testing.assert_array_equal(np.asarray(result.scores), np.asarray(ref.scores))
+    assert int(result.total_episodes) == int(ref.total_episodes)
+
+
+# ---------------------------------------------------------------------------
+# padding: popsizes that don't divide the mesh
+# ---------------------------------------------------------------------------
+
+
+def test_gspmd_popsize_1000_on_8_device_mesh(cartpole_setup):
+    env, policy, stats = cartpole_setup
+    values = _population(policy, 1000, seed=5)
+    key = jax.random.key(7)
+    kwargs = dict(num_episodes=1, episode_length=2, eval_mode="budget")
+
+    ref = run_vectorized_rollout(env, policy, values, key, stats, **kwargs)
+    ev = make_sharded_rollout_evaluator(
+        env, policy, mesh=make_mesh({"pop": 8}), **kwargs
+    )
+    result, _ = ev(values, key, stats)
+    assert result.scores.shape == (1000,)
+    np.testing.assert_array_equal(np.asarray(result.scores), np.asarray(ref.scores))
+    assert int(result.total_steps) == int(ref.total_steps) == 1000 * 2
+
+    # the same 1000 lanes on a 3-device mesh (1000 % 3 != 0): padded to
+    # 1002, sliced back, numbers untouched — what used to be an error
+    ev3 = make_sharded_rollout_evaluator(
+        env, policy, mesh=make_mesh({"pop": 3}), **kwargs
+    )
+    result3, _ = ev3(values, key, stats)
+    assert result3.scores.shape == (1000,)
+    np.testing.assert_array_equal(np.asarray(result3.scores), np.asarray(ref.scores))
+    assert int(result3.total_steps) == 1000 * 2
+
+
+def test_gspmd_padding_masks_counters_and_telemetry(cartpole_setup):
+    # 13 lanes on the 8-device grid: padded to 16, the 3 synthetic lanes
+    # must contribute NOTHING to scores, counters, or the genuine telemetry
+    # slots (capacity/lane_width count PHYSICAL lanes by design — padding
+    # is idle capacity you pay for; docs/sharding.md)
+    env, policy, stats = cartpole_setup
+    values = _population(policy, 13, seed=11)
+    key = jax.random.key(13)
+    kwargs = dict(num_episodes=1, episode_length=4, eval_mode="budget")
+
+    ref = run_vectorized_rollout(env, policy, values, key, stats, **kwargs)
+    ev = make_sharded_rollout_evaluator(
+        env, policy, mesh=make_mesh({"pop": 8}), **kwargs
+    )
+    result, _ = ev(values, key, stats)
+    assert result.scores.shape == (13,)
+    np.testing.assert_array_equal(np.asarray(result.scores), np.asarray(ref.scores))
+    assert int(result.total_steps) == 13 * 4
+    assert int(result.total_episodes) == int(ref.total_episodes)
+    telem = EvalTelemetry.from_array(result.telemetry)
+    assert telem.env_steps == 13 * 4  # genuine work only
+    assert telem.lane_width == 16  # physical (padded) lanes
+
+
+# ---------------------------------------------------------------------------
+# the one-program donated generation step
+# ---------------------------------------------------------------------------
+
+
+def test_generation_step_runs_and_donates(cartpole_setup):
+    from evotorch_tpu.algorithms.functional import pgpe, pgpe_ask, pgpe_tell
+    from evotorch_tpu.observability import ledger
+    from evotorch_tpu.observability.programs import abstract_like
+
+    env, policy, stats = cartpole_setup
+    popsize = 8
+
+    def ask(k, s):
+        return pgpe_ask(k, s, popsize=popsize)
+
+    generation = make_generation_step(
+        env, policy, ask=ask, tell=pgpe_tell, popsize=popsize,
+        mesh=make_mesh({"pop": 4, "model": 2}),
+        num_episodes=1, episode_length=4, eval_mode="budget",
+    )
+    state = pgpe(
+        center_init=jnp.zeros(policy.parameter_count),
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        objective_sense="max",
+        stdev_init=0.1,
+    )
+
+    donated = state
+    state, scores, stats_out, total_steps, _telem = generation(
+        state, jax.random.key(0), stats
+    )
+    assert scores.shape == (popsize,)
+    assert int(total_steps) == popsize * 4
+    # runtime ground truth: jax deletes exactly the donated inputs whose
+    # aliasing the executable consumed
+    assert donated.stdev.is_deleted()
+
+    # second generation (the committed-layout fixed point) still runs, and
+    # donates the first generation's output state in turn
+    state2, scores2, _, _, _ = generation(state, jax.random.key(1), stats_out)
+    assert scores2.shape == (popsize,)
+    assert state.stdev.is_deleted()
+
+    # the ledger's AOT donation verification agrees: every donated
+    # parameter is aliased in the compiled module
+    record = ledger.capture(
+        "test.gspmd.generation",
+        generation,
+        abstract_like(state2),
+        jax.random.key(2),
+        abstract_like(stats),
+        shape={"popsize": popsize, "mesh": "pop4.model2"},
+    )
+    assert record.donation is not None
+    assert record.donation.missing == ()
+
+
+# ---------------------------------------------------------------------------
+# mesh-scoped tuned-config cache keys (schema v2, backward-compatible read)
+# ---------------------------------------------------------------------------
+
+
+def test_tuned_cache_mesh_scoping_and_legacy_read(tmp_path, monkeypatch):
+    import json
+
+    from evotorch_tpu.observability.timings import (
+        TunedEntry,
+        load_tuned_cache,
+        lookup_tuned,
+        machine_fingerprint,
+        save_tuned_entry,
+    )
+
+    path = tmp_path / "tuned.json"
+    monkeypatch.setenv("EVOTORCH_TUNED_CACHE", str(path))
+    machine = machine_fingerprint()
+    base = {"env": "cartpole", "popsize": 8, "episode_length": 8,
+            "num_episodes": 1, "params": 10, "dtype": "float32"}
+
+    # a version-1 (pre-mesh) entry, as an already-checked-in cache holds
+    legacy = TunedEntry(group="refill", shape=dict(base), machine=machine,
+                        config={"width": 4}, evidence={})
+    save_tuned_entry(legacy)
+    # unsharded consumers (mesh "none") keep hitting it via the fallback
+    hit = lookup_tuned("refill", dict(base, mesh="none"))
+    assert hit is not None and hit.config["width"] == 4
+    # sharded lookups NEVER inherit a mesh-less entry
+    assert lookup_tuned("refill", dict(base, mesh="pop8")) is None
+
+    # a mesh-scoped entry serves exactly its own label
+    sharded = TunedEntry(group="refill", shape=dict(base, mesh="pop8"),
+                         machine=machine, config={"width": 8}, evidence={})
+    save_tuned_entry(sharded)
+    assert lookup_tuned("refill", dict(base, mesh="pop8")).config["width"] == 8
+    assert lookup_tuned("refill", dict(base, mesh="pop4.model2")) is None
+    # the "none" lookup still resolves to the legacy entry, not the sharded
+    assert lookup_tuned("refill", dict(base, mesh="none")).config["width"] == 4
+
+    # the save path stamps schema version 2
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["version"] == 2
+    assert len(load_tuned_cache(path)) == 2
+
+
+# ---------------------------------------------------------------------------
+# graftlint: MESH_AXES is the canonical axis registry
+# ---------------------------------------------------------------------------
+
+
+def test_graftlint_collects_mesh_axes_declaration():
+    from evotorch_tpu.analysis.graftlint import lint_sources
+
+    src_ok = (
+        'import jax\n'
+        'MESH_AXES = ("pop", "model")\n'
+        'def f(x):\n'
+        '    return jax.lax.psum(x, "model")\n'
+    )
+    findings = [f for f in lint_sources({"mod.py": src_ok}) if f.checker == "axis-name"]
+    assert findings == []
+
+    # an axis OUTSIDE the declaration fires (the checker needs at least one
+    # declaration to know the project's vocabulary)
+    src_bad = (
+        'import jax\n'
+        'MESH_AXES = ("pop", "model")\n'
+        'def f(x):\n'
+        '    return jax.lax.psum(x, "modell")\n'
+    )
+    findings = [f for f in lint_sources({"mod.py": src_bad}) if f.checker == "axis-name"]
+    assert findings
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache: warm processes deserialize instead of compiling
+# ---------------------------------------------------------------------------
+
+_CACHE_WORKER = """
+import os, sys, json, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from evotorch_tpu.observability import cache_stats, enable_persistent_cache
+enable_persistent_cache(sys.argv[1])
+
+from evotorch_tpu.envs import CartPole
+from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+from evotorch_tpu.observability import ledger
+from evotorch_tpu.observability.programs import abstract_like
+from evotorch_tpu.parallel import make_mesh, make_sharded_rollout_evaluator
+
+env = CartPole()
+policy = FlatParamsPolicy(
+    Linear(env.observation_size, 8) >> Tanh() >> Linear(8, env.action_size)
+)
+stats = RunningNorm(env.observation_size).stats
+ev = make_sharded_rollout_evaluator(
+    env, policy, mesh=make_mesh({"pop": 4, "model": 2}),
+    num_episodes=1, episode_length=16, eval_mode="budget",
+)
+record = ledger.capture(
+    "cache_probe",
+    ev.program_builder(False, 64),
+    abstract_like(jax.numpy.zeros((64, policy.parameter_count))),
+    jax.random.key(0),
+    abstract_like(stats),
+)
+print("CACHE", json.dumps({
+    "compile_seconds": record.compile_seconds, **cache_stats()
+}))
+"""
+
+
+@pytest.mark.slow
+def test_persistent_compile_cache_warm_process(tmp_path):
+    # the acceptance criterion: a second process's compile_seconds for the
+    # same program is < 25% of the first's (deserialization, not XLA)
+    import json
+    import os
+    import subprocess
+    import sys
+
+    worker = tmp_path / "cache_worker.py"
+    worker.write_text(_CACHE_WORKER)
+    cache_dir = tmp_path / "compile_cache"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, str(worker), str(cache_dir)],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        assert out.returncode == 0, f"worker failed:\n{out.stdout}\n{out.stderr}"
+        for line in out.stdout.splitlines():
+            if line.startswith("CACHE "):
+                return json.loads(line[len("CACHE "):])
+        raise AssertionError(f"no CACHE line in:\n{out.stdout}")
+
+    cold = run()
+    warm = run()
+    assert cold["enabled"] and warm["enabled"]
+    assert cold["hits"] == 0 and cold["misses"] > 0
+    assert warm["misses"] == 0 and warm["hits"] > 0
+    assert warm["compile_seconds"] < 0.25 * cold["compile_seconds"], (cold, warm)

@@ -712,8 +712,9 @@ def test_oo_pgpe_lowrank_distributed_adaptive_vecne():
 
 
 def test_sharded_grad_estimator_lowrank_matches_local_math():
-    # the sharded factored estimator on a 1-shard mesh must equal the
-    # classmethod pipeline run by hand with the same folded key
+    # the GSPMD factored estimator samples with the GLOBAL key (single-
+    # process semantics): on a 1-shard mesh it must equal the classmethod
+    # pipeline run by hand with the same key, no per-shard fold
     from evotorch_tpu.distributions import SymmetricSeparableGaussian
     from evotorch_tpu.parallel.grad import make_sharded_grad_estimator
     from evotorch_tpu.parallel.mesh import default_mesh
@@ -743,8 +744,7 @@ def test_sharded_grad_estimator_lowrank_matches_local_math():
     key = jax.random.key(3)
     grads = est(key, n, params)
 
-    my_key = jax.random.fold_in(key, 0)
-    samples = SymmetricSeparableGaussian._sample_lowrank(my_key, params, n, k)
+    samples = SymmetricSeparableGaussian._sample_lowrank(key, params, n, k)
     weights = rank(fitness(samples.materialize()), "centered", higher_is_better=True)
     want = SymmetricSeparableGaussian._compute_gradients(
         params, samples, weights, "centered"
