@@ -26,7 +26,13 @@ contract; per-mode values inside ``modes``), ``refill_events`` (items the
 refill scheduler recycled lanes for) and ``steady_compiles`` (retrace
 sentinel count over every timed loop — anything but 0 is a retrace bug).
 ``BENCH_TELEMETRY=0`` compiles the accumulator-free programs (the overhead
-A/B baseline).
+A/B baseline). Each mode also reports ``queue_wait_p50``/``queue_wait_p99``
+(decoded from the on-device queue-wait histograms); ``BENCH_GROUPS=G``
+round-robins group ids across the population and switches the wire to the
+per-group ``(G, 14)`` matrix (the per-group accounting overhead shape);
+``EVOTORCH_METRICS=path`` streams the line + decoded per-group telemetry +
+counter registry through the MetricsHub (JSONL manifest-first, or
+Prometheus text with a ``.prom`` suffix).
 
 The program LEDGER (docs/observability.md "Program ledger") adds, per
 contract and hoisted top-level for the primary one: ``compile_seconds``
@@ -102,7 +108,7 @@ def main():
         run_vectorized_rollout,
         run_vectorized_rollout_compacting,
     )
-    from evotorch_tpu.observability import EvalTelemetry
+    from evotorch_tpu.observability import GroupTelemetry, MetricsHub
     from evotorch_tpu.observability import ledger as program_ledger
     from evotorch_tpu.observability.inventory import capture_compact_chunk
     from evotorch_tpu.observability.programs import abstract_like
@@ -149,6 +155,13 @@ def main():
         compute_dtype=compute_dtype,
         telemetry=cfg["telemetry"],
     )
+    num_groups = cfg["num_groups"] if cfg["telemetry"] else 0
+    if num_groups > 1:
+        # BENCH_GROUPS=G: round-robin group ids over the population — the
+        # telemetry wire becomes the per-group (G, 14) matrix, the overhead
+        # A/B shape for the segment-summed accounting
+        rollout_kwargs["groups"] = jnp.arange(popsize, dtype=jnp.int32) % num_groups
+        rollout_kwargs["num_groups"] = num_groups
 
     def measure_mode(mode, key):
         """Run warmup + ``generations`` timed generations of one contract;
@@ -213,9 +226,10 @@ def main():
                 jax.block_until_ready(scores)
                 total_steps += int(steps)
             elapsed = time.perf_counter() - t0
-        decoded = (
-            EvalTelemetry.from_array(telemetry) if telemetry is not None else None
+        gdec = (
+            GroupTelemetry.from_array(telemetry) if telemetry is not None else None
         )
+        decoded = gdec.total() if gdec is not None else None
         print(
             f"[{mode}] {generations} generations, {total_steps} env-steps in "
             f"{elapsed:.2f}s; mean score {float(jnp.mean(scores)):.3f}"
@@ -260,7 +274,7 @@ def main():
             total_steps / elapsed,
             generations / elapsed,
             key,
-            decoded,
+            gdec,
             compile_log.count,
             record,
         )
@@ -278,12 +292,15 @@ def main():
         if m != eval_mode
     ]
     telemetry_by_mode = {}
+    group_telemetry_by_mode = {}
     steady_compiles = 0
     for mode in all_modes:
-        sps, gps, key, mode_telemetry, mode_compiles, record = measure_mode(
+        sps, gps, key, mode_groups, mode_compiles, record = measure_mode(
             mode, key
         )
+        mode_telemetry = mode_groups.total() if mode_groups is not None else None
         telemetry_by_mode[mode] = mode_telemetry
+        group_telemetry_by_mode[mode] = mode_groups
         steady_compiles += mode_compiles
         modes[mode] = {
             "value": round(sps, 1),
@@ -292,6 +309,11 @@ def main():
         }
         if mode_telemetry is not None:
             modes[mode]["occupancy"] = round(mode_telemetry.occupancy, 4)
+            # queue-wait tail decoded from the on-device histograms — refill
+            # is the only contract whose lanes wait, so the other modes read
+            # 0.0 (absent entirely under BENCH_TELEMETRY=0)
+            modes[mode]["queue_wait_p50"] = mode_groups.queue_wait_quantile(0.5)
+            modes[mode]["queue_wait_p99"] = mode_groups.queue_wait_quantile(0.99)
         if record is not None:
             # the compact record covers ONE full-width chunk, not a whole
             # generation: its per-step denominator is the chunk's executed
@@ -417,6 +439,21 @@ def main():
         # (sync chunked loop vs pipelined refill scheduler over MjVecEnv);
         # off by default so the line above stays byte-compatible
         line.update(measure_mujoco(cfg))
+    hub = MetricsHub.from_env(
+        manifest={
+            "source": "bench",
+            "mesh": "none",
+            "env": cfg["env_name"],
+            "popsize": popsize,
+            "num_groups": num_groups,
+            "tuned_config_source": line.get("tuned_config_source"),
+        }
+    )
+    if hub is not None:
+        # EVOTORCH_METRICS=path: the same line (plus the primary contract's
+        # decoded per-group telemetry and the counter registry) as one
+        # schema-versioned stream record
+        hub.emit(line, telemetry=group_telemetry_by_mode.get(eval_mode))
     print(json.dumps(line))
 
 

@@ -68,6 +68,11 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
         # the A/B baseline proving the zero-sync telemetry costs nothing
         # (docs/observability.md); default on
         "telemetry": os.environ.get("BENCH_TELEMETRY", "1") != "0",
+        # BENCH_GROUPS=G (with telemetry on) assigns round-robin group ids
+        # across the population and switches the telemetry wire to the
+        # per-group (G, 14) matrix — the per-group accounting overhead A/B
+        # (docs/observability.md "Per-group telemetry & SLOs"); 0/1 = off
+        "num_groups": int(os.environ.get("BENCH_GROUPS", "0")),
         # BENCH_LEDGER=0 skips the program-ledger capture (one extra AOT
         # trace+compile per contract, outside every timed region) and with
         # it the compile_seconds / flops_per_step / peak_hbm_bytes /

@@ -38,7 +38,13 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from ...observability.devicemetrics import pack_eval_telemetry
+from ...observability.devicemetrics import (
+    QUEUE_WAIT_BUCKETS,
+    TELEMETRY_WIDTH,
+    pack_eval_telemetry,
+    pack_group_telemetry,
+    queue_wait_bucket_index,
+)
 from ..net.functional import FlatParamsPolicy
 from ..net.lowrank import LowRankParamsBatch, lowrank_forward, prepare_lowrank
 from ..net.rl import alive_bonus_for_step
@@ -189,15 +195,69 @@ class Policy:
         return self._state
 
 
+# telemetry matrix column indices (devicemetrics._SLOTS order)
+_COL_ENV_STEPS, _COL_EPISODES, _COL_CAPACITY, _COL_LANE_WIDTH, _COL_REFILL, _COL_WAIT = range(6)
+
+
+def _empty_lane_groups():
+    """The lane_groups sentinel when per-group accounting is off: a (0,)
+    int32 array (shape-stable, costs nothing in the carry)."""
+    return jnp.zeros((0,), dtype=jnp.int32)
+
+
+def _empty_group_counts():
+    """The group_counts sentinel when per-group accounting is off."""
+    return jnp.zeros((0, TELEMETRY_WIDTH), dtype=jnp.int32)
+
+
+def _init_group_counts(lane_groups, num_groups: int):
+    """A fresh (G, TELEMETRY_WIDTH) counter block with the lane_width column
+    set from the initial lane->group assignment (every other column
+    accumulates in the stepping loop)."""
+    widths = jax.ops.segment_sum(
+        jnp.ones(lane_groups.shape[0], dtype=jnp.int32),
+        lane_groups,
+        num_segments=num_groups,
+    )
+    return (
+        jnp.zeros((num_groups, TELEMETRY_WIDTH), dtype=jnp.int32)
+        .at[:, _COL_LANE_WIDTH]
+        .add(widths)
+    )
+
+
+def _fold_lane_counts(
+    group_counts, lane_steps, lane_episodes, lane_groups, t_global, num_groups, mask=None
+):
+    """Fold the per-lane step/episode accumulators into the per-group counter
+    block: one segment_sum at a loop boundary instead of one per loop
+    iteration. A lane's capacity charge is ``t_global`` — every lane still in
+    the carry has been present since t=0 (compaction only ever drops lanes),
+    so ``width x iterations`` decomposes into ``t_global`` per present lane.
+    ``mask`` (int-castable, per lane) restricts the fold to a subset — the
+    lanes being dropped at a compaction boundary; the survivors keep
+    accumulating and fold at the next boundary."""
+    width = lane_steps.shape[0]
+    per_lane = jnp.stack(
+        [lane_steps, lane_episodes, jnp.broadcast_to(t_global, (width,))], axis=1
+    )
+    if mask is not None:
+        per_lane = per_lane * mask.astype(jnp.int32)[:, None]
+    return group_counts.at[:, :_COL_LANE_WIDTH].add(
+        jax.ops.segment_sum(per_lane, lane_groups, num_segments=num_groups)
+    )
+
+
 class RolloutResult(NamedTuple):
     scores: jnp.ndarray  # (N,) mean episodic return per solution
     stats: CollectedStats  # obs-norm statistics collected during the rollout
     total_steps: jnp.ndarray  # scalar: total env interactions
     total_episodes: jnp.ndarray  # scalar: episodes finished
     # packed on-device eval telemetry (observability.devicemetrics): one
-    # (TELEMETRY_WIDTH,) int32 vector computed inside the same jitted program
-    # as the scores — fetching it is part of the same transfer, never a new
-    # dispatch. None when the engine ran with telemetry=False.
+    # (G, GROUP_TELEMETRY_WIDTH) int32 matrix (G=1 without per-group
+    # accounting) computed inside the same jitted program as the scores —
+    # fetching it is part of the same transfer, never a new dispatch. None
+    # when the engine ran with telemetry=False.
     telemetry: Any = None
 
 
@@ -223,6 +283,20 @@ class RolloutCarry(NamedTuple):
     # occupancy denominator (observability.devicemetrics); frozen at its
     # initial zero when the engine runs with telemetry off
     capacity: jnp.ndarray
+    # per-group accounting (ISSUE 15): lane_groups is the (n,) group id each
+    # lane charges its counters to, group_counts the (G, TELEMETRY_WIDTH)
+    # per-group counter block. The hot loop only bumps the per-lane
+    # accumulators lane_steps/lane_episodes (two elementwise adds); the
+    # segment_sum fold into group_counts happens ONCE at a loop boundary
+    # (_fold_lane_counts) — lane->group ids never change inside these
+    # engines, so the fold commutes with the loop and the per-step cost is
+    # G-independent. All four are empty (0-row) sentinels when
+    # num_groups == 1 or telemetry is off, so the single-group program
+    # carries no group state at all.
+    lane_groups: jnp.ndarray
+    group_counts: jnp.ndarray
+    lane_steps: jnp.ndarray
+    lane_episodes: jnp.ndarray
 
 
 def _policy_to_action(raw, action_space, noise, clip: bool):
@@ -311,6 +385,8 @@ def _rollout_init(
     stats_sync_axis=None,
     num_valid=None,
     pad_episodes_done: int = 0,
+    groups=None,
+    num_groups: int = 1,
 ):
     """Build the initial carry (full width) and the compute-dtype params.
 
@@ -352,6 +428,21 @@ def _rollout_init(
 
     policy_states = _initial_policy_states(policy, n, compute_dtype)
 
+    if groups is not None and num_groups > 1:
+        # lane i charges group groups[i]; the lane_width column is set once
+        # here (physical lanes per group — padding lanes included, matching
+        # the v1 global's physical lane_width), everything else accumulates
+        # per lane in the stepping loop and folds at the boundary
+        lane_groups = jnp.asarray(groups, dtype=jnp.int32)
+        group_counts = _init_group_counts(lane_groups, num_groups)
+        lane_steps0 = jnp.zeros(n, dtype=jnp.int32)
+        lane_episodes0 = jnp.zeros(n, dtype=jnp.int32)
+    else:
+        lane_groups = _empty_lane_groups()
+        group_counts = _empty_group_counts()
+        lane_steps0 = _empty_lane_groups()
+        lane_episodes0 = _empty_lane_groups()
+
     episodes_done0 = (
         jnp.zeros(n, dtype=jnp.int32)
         if num_valid is None
@@ -370,6 +461,10 @@ def _rollout_init(
         total_steps=jnp.zeros((), dtype=jnp.int32),
         t_global=jnp.zeros((), dtype=jnp.int32),
         capacity=jnp.zeros((), dtype=jnp.int32),
+        lane_groups=lane_groups,
+        group_counts=group_counts,
+        lane_steps=lane_steps0,
+        lane_episodes=lane_episodes0,
     )
     return carry, params_batch
 
@@ -399,6 +494,7 @@ def _make_step(
     stats_sync_axis=None,
     collect_telemetry: bool = True,
     masked_width: bool = False,
+    num_groups: int = 1,
 ):
     """One masked control step of the whole population, as a pure function
     ``step(params_batch, carry) -> carry``. Width is taken from the carry, so
@@ -407,6 +503,11 @@ def _make_step(
     ``collect_telemetry``: accumulate the observability counters (one extra
     int32 scalar add per step — the ``capacity`` carry); False freezes the
     telemetry fields so an A/B against a telemetry-free program is possible.
+
+    ``num_groups > 1``: additionally ``segment_sum`` the per-lane
+    env-step/episode/capacity increments into the carry's per-group counter
+    block every step (ISSUE 15) — one tiny (n -> G) reduction, still zero
+    host syncs.
 
     ``stats_sync_axis``: inside a ``shard_map`` over that axis, psum-merge
     the per-step observation-statistic deltas so every shard normalizes by
@@ -525,6 +626,21 @@ def _make_step(
         if observation_normalization and stats_sync_axis is not None:
             new_stats = _stats_psum_merge(c.stats, new_stats, stats_sync_axis)
 
+        if collect_telemetry and num_groups > 1:
+            # per-group accounting: lane i charges its env-step (if active)
+            # and episode completion (if it fired this step) to PER-LANE
+            # accumulators — two fused elementwise adds; the segment_sum into
+            # group_counts happens once at the loop boundary
+            # (_fold_lane_counts), so the per-step cost is G-independent.
+            # Padding lanes never activate or fire, so their only charge is
+            # capacity (t_global at fold time) — the same semantics as the
+            # v1 global scalars.
+            lane_steps = c.lane_steps + active_f.astype(jnp.int32)
+            lane_episodes = c.lane_episodes + finished.astype(jnp.int32)
+        else:
+            lane_steps = c.lane_steps
+            lane_episodes = c.lane_episodes
+
         return RolloutCarry(
             env_states=env_states_next,
             obs=obs_next,
@@ -540,6 +656,10 @@ def _make_step(
             # telemetry: every iteration executes `n` lane-step slots,
             # whether the lanes are live or idling masked
             capacity=(c.capacity + n) if collect_telemetry else c.capacity,
+            lane_groups=c.lane_groups,
+            group_counts=c.group_counts,
+            lane_steps=lane_steps,
+            lane_episodes=lane_episodes,
         )
 
     return step
@@ -564,6 +684,7 @@ def _make_step(
         "seed_stride",
         "telemetry",
         "num_valid",
+        "num_groups",
     ),
 )
 def run_vectorized_rollout(
@@ -588,16 +709,31 @@ def run_vectorized_rollout(
     seed_stride: Optional[int] = None,
     telemetry: bool = True,
     num_valid: Optional[int] = None,
+    groups=None,
+    num_groups: int = 1,
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
 
     ``telemetry`` (default on): accumulate the zero-sync observability
     counters in the loop carry and return them packed in
-    ``RolloutResult.telemetry`` — a ``(TELEMETRY_WIDTH,)`` int32 vector
-    produced by the same jitted program as the scores (zero extra
-    dispatches; see ``observability.devicemetrics``). ``telemetry=False``
-    compiles the accumulator-free program — the A/B baseline for measuring
-    that the accumulators cost nothing.
+    ``RolloutResult.telemetry`` — a ``(num_groups,
+    GROUP_TELEMETRY_WIDTH)`` int32 matrix produced by the same jitted
+    program as the scores (zero extra dispatches; see
+    ``observability.devicemetrics``). ``telemetry=False`` compiles the
+    accumulator-free program — the A/B baseline for measuring that the
+    accumulators cost nothing.
+
+    ``groups`` / ``num_groups`` (ISSUE 15): per-group telemetry. ``groups``
+    is an ``(N,)`` int32 array of group ids in ``[0, num_groups)`` — one per
+    SOLUTION — and every telemetry slot is ``segment_sum``-accumulated per
+    group inside the same loop carry (the substrate for multi-tenant
+    occupancy/fairness accounting and per-island counters). The column sums
+    of the per-group matrix equal the single-group global numbers exactly.
+    With ``num_groups == 1`` (default) no group state is carried at all. In
+    ``episodes_refill`` mode the telemetry additionally carries per-group
+    queue-wait histograms (log-spaced buckets; see
+    ``devicemetrics.QUEUE_WAIT_BUCKET_EDGES``) fed by each refilled item's
+    idle-to-refill wait.
 
     Randomness is a PER-LANE property: lane ``i``'s PRNG chain is seeded by
     ``fold_in(key, lane_ids[i])`` (default ``lane_ids = arange(N)``) and
@@ -671,6 +807,12 @@ def run_vectorized_rollout(
             f" got {eval_mode!r}"
         )
     n_total = _params_popsize(params_batch)
+    num_groups = int(num_groups)
+    if num_groups > 1 and groups is None:
+        raise ValueError("num_groups > 1 requires a groups array of per-solution ids")
+    collect_groups = telemetry and num_groups > 1
+    if not collect_groups:
+        groups, num_groups = None, 1
     if num_valid is not None:
         num_valid = int(num_valid)
         if not (1 <= num_valid <= n_total):
@@ -703,6 +845,8 @@ def run_vectorized_rollout(
             seed_stride=seed_stride,
             telemetry=telemetry,
             num_valid=num_valid,
+            groups=groups,
+            num_groups=num_groups,
         )
     hard_cap = max_t * int(num_episodes) + 1
     budget_mode = eval_mode == "budget"
@@ -722,6 +866,8 @@ def run_vectorized_rollout(
         # exit condition; budget-mode lanes never finish (masked inactive),
         # so their episodes_done stays 0 and total_episodes needs no fixup
         pad_episodes_done=0 if budget_mode else int(num_episodes),
+        groups=groups,
+        num_groups=num_groups,
     )
     step = _make_step(
         env,
@@ -737,6 +883,7 @@ def run_vectorized_rollout(
         stats_sync_axis=stats_sync_axis,
         collect_telemetry=telemetry,
         masked_width=num_valid is not None,
+        num_groups=num_groups,
     )
 
     ctx = _forward_ctx(policy, params_batch)
@@ -774,21 +921,37 @@ def run_vectorized_rollout(
         total_episodes = total_episodes - jnp.int32(
             (n_total - num_valid) * int(num_episodes)
         )
-    return RolloutResult(
-        scores=mean_scores,
-        stats=final.stats,
-        total_steps=final.total_steps,
-        total_episodes=total_episodes,
-        telemetry=(
+    if not telemetry:
+        eval_telemetry = None
+    elif collect_groups:
+        # the per-group counter block IS the telemetry (no histograms in the
+        # non-refill engines: nothing queues, nothing waits); the per-lane
+        # accumulators fold here, once, after the loop
+        eval_telemetry = pack_group_telemetry(
+            _fold_lane_counts(
+                final.group_counts,
+                final.lane_steps,
+                final.lane_episodes,
+                final.lane_groups,
+                final.t_global,
+                num_groups,
+            )
+        )
+    else:
+        eval_telemetry = pack_group_telemetry(
             pack_eval_telemetry(
                 env_steps=final.total_steps,
                 episodes=total_episodes,
                 capacity=final.capacity,
                 lane_width=final.active.shape[0],
-            )
-            if telemetry
-            else None
-        ),
+            )[None]
+        )
+    return RolloutResult(
+        scores=mean_scores,
+        stats=final.stats,
+        total_steps=final.total_steps,
+        total_episodes=total_episodes,
+        telemetry=eval_telemetry,
     )
 
 
@@ -840,6 +1003,16 @@ class RefillCarry(NamedTuple):
     # zero when the engine runs with telemetry off.
     capacity: jnp.ndarray
     wait_sum: jnp.ndarray
+    # queue-wait histogramming (ISSUE 15): idle_since stamps the loop step
+    # at which each lane's episode finished; when a refill reuses the lane,
+    # (now - stamp) is the item's wait, bucketed into the (G, B) log-spaced
+    # histogram `hist`. lane_groups/group_counts mirror RolloutCarry's
+    # per-group accounting (empty sentinels at num_groups == 1); with
+    # telemetry off idle_since/hist are empty sentinels too.
+    idle_since: jnp.ndarray
+    hist: jnp.ndarray
+    lane_groups: jnp.ndarray
+    group_counts: jnp.ndarray
 
 
 def _default_refill_width(total_items: int) -> int:
@@ -921,6 +1094,8 @@ def _run_refill(
     seed_stride,
     telemetry=True,
     num_valid=None,
+    groups=None,
+    num_groups=1,
 ) -> RolloutResult:
     """The ``episodes_refill`` evaluation: exact ``episodes`` semantics (each
     solution is scored by the mean return of exactly ``num_episodes``
@@ -950,6 +1125,11 @@ def _run_refill(
         lane_ids = jnp.arange(n, dtype=jnp.int32)
     store, forward = _refill_forward_setup(policy, params_batch)
 
+    collect_groups = bool(telemetry) and int(num_groups) > 1 and groups is not None
+    groups_arr = (
+        jnp.asarray(groups, dtype=jnp.int32) if collect_groups else None
+    )
+
     def item_keys(items):
         """(chain, reset) PRNG keys + solution index of queue items. Episode
         ``e`` of solution ``s`` is seeded ``fold_in(key, lane_ids[s] +
@@ -977,6 +1157,22 @@ def _run_refill(
 
     policy_states0 = _initial_policy_states(policy, width, compute_dtype)
 
+    if telemetry:
+        # the histogram is carried even at G=1 (one row): tail queue wait is
+        # a property of the refill schedule, not of multi-tenancy
+        hist_groups = int(num_groups) if collect_groups else 1
+        hist0 = jnp.zeros((hist_groups, QUEUE_WAIT_BUCKETS), dtype=jnp.int32)
+        idle_since0 = jnp.zeros(width, dtype=jnp.int32)
+    else:
+        hist0 = jnp.zeros((0, QUEUE_WAIT_BUCKETS), dtype=jnp.int32)
+        idle_since0 = jnp.zeros((0,), dtype=jnp.int32)
+    if collect_groups:
+        lane_groups0 = groups_arr[sol0]
+        group_counts0 = _init_group_counts(lane_groups0, int(num_groups))
+    else:
+        lane_groups0 = _empty_lane_groups()
+        group_counts0 = _empty_group_counts()
+
     carry = RefillCarry(
         env_states=env_states0,
         obs=obs0,
@@ -995,6 +1191,10 @@ def _run_refill(
         t_global=jnp.zeros((), dtype=jnp.int32),
         capacity=jnp.zeros((), dtype=jnp.int32),
         wait_sum=jnp.zeros((), dtype=jnp.int32),
+        idle_since=idle_since0,
+        hist=hist0,
+        lane_groups=lane_groups0,
+        group_counts=group_counts0,
     )
 
     def step(c: RefillCarry) -> RefillCarry:
@@ -1122,8 +1322,57 @@ def _run_refill(
                 jnp.sum((~active).astype(jnp.int32)),
                 0,
             )
+            # queue-wait histogram: a lane's wait is refill step minus the
+            # step its previous episode finished (same-step refill = 0 →
+            # bucket 0). `take` is all-False when the cond gate is closed,
+            # so updating outside the cond adds zeros — no divergence.
+            # Lanes drained at queue end never refill → never counted.
+            tcur = c.t_global + 1
+            idle_since = jnp.where(finished, tcur, c.idle_since)
+            waits = jnp.where(take, tcur - idle_since, 0)
+            buckets = queue_wait_bucket_index(waits)
+            take_i = take.astype(jnp.int32)
+            if collect_groups:
+                sol_in = jnp.where(take, cand, 0) % nv
+                g_in = groups_arr[sol_in]
+                hist = c.hist.at[g_in, buckets].add(take_i)
+                lane_groups = jnp.where(take, g_in, c.lane_groups)
+                per_lane = jnp.stack(
+                    [
+                        active_f.astype(jnp.int32),
+                        finished.astype(jnp.int32),
+                        jnp.ones(width, dtype=jnp.int32),
+                    ],
+                    axis=1,
+                )
+                group_counts = c.group_counts.at[:, : _COL_LANE_WIDTH].add(
+                    jax.ops.segment_sum(
+                        per_lane, c.lane_groups, num_segments=num_groups
+                    )
+                )
+                group_counts = group_counts.at[:, _COL_REFILL].add(
+                    jax.ops.segment_sum(
+                        take_i, g_in, num_segments=num_groups
+                    )
+                )
+                # per-step gating matches the scalar wait_sum above (the
+                # UPDATED next_item), so the column sum equals it exactly
+                wait_lane = jnp.where(
+                    next_item < total_items, (~active).astype(jnp.int32), 0
+                )
+                group_counts = group_counts.at[:, _COL_WAIT].add(
+                    jax.ops.segment_sum(
+                        wait_lane, lane_groups, num_segments=num_groups
+                    )
+                )
+            else:
+                hist = c.hist.at[0, buckets].add(take_i)
+                lane_groups = c.lane_groups
+                group_counts = c.group_counts
         else:
             capacity, wait_sum = c.capacity, c.wait_sum
+            idle_since, hist = c.idle_since, c.hist
+            lane_groups, group_counts = c.lane_groups, c.group_counts
 
         # obs-norm statistics count ONLY live-lane observations: the
         # post-refill obs each still-active lane will consume next step
@@ -1154,6 +1403,10 @@ def _run_refill(
             t_global=c.t_global + 1,
             capacity=capacity,
             wait_sum=wait_sum,
+            idle_since=idle_since,
+            hist=hist,
+            lane_groups=lane_groups,
+            group_counts=group_counts,
         )
 
     # greedy-scheduling makespan bound (total work / W + longest item) plus
@@ -1187,18 +1440,23 @@ def _run_refill(
         total_steps=final.total_steps,
         total_episodes=total_episodes,
         telemetry=(
-            pack_eval_telemetry(
-                env_steps=final.total_steps,
-                episodes=total_episodes,
-                capacity=final.capacity,
-                lane_width=width,
-                # items 0..width-1 seeded the lanes; everything past that
-                # entered through the refill gather
-                refill_events=final.next_item - jnp.int32(width),
-                queue_wait=final.wait_sum,
+            None
+            if not telemetry
+            else pack_group_telemetry(final.group_counts, final.hist)
+            if collect_groups
+            else pack_group_telemetry(
+                pack_eval_telemetry(
+                    env_steps=final.total_steps,
+                    episodes=total_episodes,
+                    capacity=final.capacity,
+                    lane_width=width,
+                    # items 0..width-1 seeded the lanes; everything past
+                    # that entered through the refill gather
+                    refill_events=final.next_item - jnp.int32(width),
+                    queue_wait=final.wait_sum,
+                )[None],
+                final.hist,
             )
-            if telemetry
-            else None
         ),
     )
 
@@ -1217,9 +1475,11 @@ def _compacting_fns(
     compute_dtype,
     stats_sync_axis=None,
     collect_telemetry=True,
+    num_groups=1,
 ):
     """Jitted building blocks of the compacting runner, cached per config so
     repeated calls (every generation) hit XLA's compile cache."""
+    num_groups = int(num_groups)
     step = _make_step(
         env,
         policy,
@@ -1233,10 +1493,11 @@ def _compacting_fns(
         budget_mode=False,
         stats_sync_axis=stats_sync_axis,
         collect_telemetry=collect_telemetry,
+        num_groups=num_groups,
     )
 
     @jax.jit
-    def init_fn(params_batch, key, stats, lane_ids=None):
+    def init_fn(params_batch, key, stats, lane_ids=None, groups=None):
         return _rollout_init(
             env,
             policy,
@@ -1247,6 +1508,8 @@ def _compacting_fns(
             compute_dtype=compute_dtype,
             lane_ids=lane_ids,
             stats_sync_axis=stats_sync_axis,
+            groups=groups,
+            num_groups=num_groups,
         )
 
     @partial(jax.jit, static_argnames=("num_steps",))
@@ -1279,6 +1542,24 @@ def _compacting_fns(
         eps_buf = eps_buf.at[lane_ids].set(carry.episodes_done)
         order = jnp.argsort(jnp.logical_not(carry.active))  # stable: active first
         sel = order[:new_width]
+        if num_groups > 1:
+            # the lanes dropped here leave the carry for good: fold their
+            # per-lane accumulators into the group block now (their capacity
+            # charge is t_global — present since t=0); survivors keep
+            # accumulating and fold at finalize
+            width = carry.active.shape[0]
+            dropped = jnp.ones(width, bool).at[sel].set(False)
+            group_counts = _fold_lane_counts(
+                carry.group_counts,
+                carry.lane_steps,
+                carry.lane_episodes,
+                carry.lane_groups,
+                carry.t_global,
+                num_groups,
+                mask=dropped,
+            )
+        else:
+            group_counts = carry.group_counts
         new_carry = RolloutCarry(
             env_states=_env_state_take(env, carry.env_states, sel),
             obs=carry.obs[sel],
@@ -1296,6 +1577,19 @@ def _compacting_fns(
             total_steps=carry.total_steps,
             t_global=carry.t_global,
             capacity=carry.capacity,  # capacity already paid at prior widths
+            # the folded group block survives compaction whole; lane group
+            # ids and per-lane accumulators travel with their lanes like the
+            # PRNG chains
+            lane_groups=(
+                carry.lane_groups[sel] if num_groups > 1 else carry.lane_groups
+            ),
+            group_counts=group_counts,
+            lane_steps=(
+                carry.lane_steps[sel] if num_groups > 1 else carry.lane_steps
+            ),
+            lane_episodes=(
+                carry.lane_episodes[sel] if num_groups > 1 else carry.lane_episodes
+            ),
         )
         return new_carry, _params_take(params_batch, sel), lane_ids[sel], scores_buf, eps_buf
 
@@ -1305,18 +1599,32 @@ def _compacting_fns(
         eps_buf = eps_buf.at[lane_ids].set(carry.episodes_done)
         mean_scores = scores_buf / jnp.maximum(eps_buf, 1)
         total_episodes = jnp.sum(eps_buf)
-        telemetry = (
-            pack_eval_telemetry(
-                env_steps=carry.total_steps,
-                episodes=total_episodes,
-                # carry.capacity summed width x iterations through every
-                # compaction, so occupancy credits the narrowing directly
-                capacity=carry.capacity,
-                lane_width=scores_buf.shape[0],
+        if not collect_telemetry:
+            telemetry = None
+        elif num_groups > 1:
+            # fold the surviving lanes' accumulators (dropped lanes folded at
+            # their compaction boundary)
+            telemetry = pack_group_telemetry(
+                _fold_lane_counts(
+                    carry.group_counts,
+                    carry.lane_steps,
+                    carry.lane_episodes,
+                    carry.lane_groups,
+                    carry.t_global,
+                    num_groups,
+                )
             )
-            if collect_telemetry
-            else None
-        )
+        else:
+            telemetry = pack_group_telemetry(
+                pack_eval_telemetry(
+                    env_steps=carry.total_steps,
+                    episodes=total_episodes,
+                    # carry.capacity summed width x iterations through every
+                    # compaction, so occupancy credits the narrowing directly
+                    capacity=carry.capacity,
+                    lane_width=scores_buf.shape[0],
+                )[None]
+            )
         return mean_scores, total_episodes, telemetry
 
     return init_fn, chunk_fn, compact_fn, finalize_fn
@@ -1341,6 +1649,8 @@ def run_vectorized_rollout_compacting(
     allowed_widths: Optional[tuple] = None,
     prewarm: bool = False,
     telemetry: bool = True,
+    groups=None,
+    num_groups: int = 1,
 ) -> RolloutResult:
     """Episodes-contract evaluation with **lane compaction** — the
     host-orchestrated fast path for ``eval_mode="episodes"``.
@@ -1391,6 +1701,12 @@ def run_vectorized_rollout_compacting(
         max_t = min(max_t, int(episode_length))
     hard_cap = max_t * int(num_episodes) + 1
 
+    num_groups = int(num_groups)
+    if num_groups > 1 and groups is None:
+        raise ValueError("num_groups > 1 requires a groups array of per-solution ids")
+    if not (telemetry and num_groups > 1):
+        groups, num_groups = None, 1
+
     init_fn, chunk_fn, compact_fn, finalize_fn = _compacting_fns(
         env,
         policy,
@@ -1403,6 +1719,7 @@ def run_vectorized_rollout_compacting(
         action_noise_stdev,
         compute_dtype,
         collect_telemetry=bool(telemetry),
+        num_groups=num_groups,
     )
 
     if allowed_widths is None:
@@ -1421,7 +1738,12 @@ def run_vectorized_rollout_compacting(
     else:
         allowed_widths = tuple(sorted(int(w) for w in allowed_widths if w < n))
 
-    carry, params = init_fn(params_batch, key, stats)
+    carry, params = init_fn(
+        params_batch,
+        key,
+        stats,
+        groups=(jnp.asarray(groups, dtype=jnp.int32) if num_groups > 1 else None),
+    )
     lane_ids = jnp.arange(n, dtype=jnp.int32)
     scores_buf = jnp.zeros(n, dtype=jnp.float32)
     eps_buf = jnp.zeros(n, dtype=jnp.int32)
@@ -1509,6 +1831,9 @@ def _expand_shard_scalars(carry: "RolloutCarry") -> "RolloutCarry":
         total_steps=carry.total_steps[None],
         t_global=carry.t_global[None],
         capacity=carry.capacity[None],
+        # per-shard PARTIAL per-group sums (psum'd at finalize); lane_groups
+        # is a lane leaf and shards like scores
+        group_counts=carry.group_counts[None],
     )
 
 
@@ -1519,6 +1844,7 @@ def _squeeze_shard_scalars(carry: "RolloutCarry") -> "RolloutCarry":
         total_steps=carry.total_steps[0],
         t_global=carry.t_global[0],
         capacity=carry.capacity[0],
+        group_counts=carry.group_counts[0],
     )
 
 
@@ -1546,6 +1872,10 @@ def _sharded_carry_specs(env, axis_name: str) -> "RolloutCarry":
         total_steps=lane,
         t_global=lane,
         capacity=lane,
+        lane_groups=lane,
+        group_counts=lane,
+        lane_steps=lane,
+        lane_episodes=lane,
     )
 
 
@@ -1584,9 +1914,11 @@ def _compacting_sharded_fns(
     lowrank: bool,
     stats_sync: bool = False,
     collect_telemetry: bool = True,
+    num_groups: int = 1,
 ):
     from jax.sharding import PartitionSpec as P
 
+    num_groups = int(num_groups)
     init_fn, chunk_fn, compact_fn, finalize_fn = _compacting_fns(
         env,
         policy,
@@ -1600,33 +1932,62 @@ def _compacting_sharded_fns(
         compute_dtype,
         stats_sync_axis=axis_name if stats_sync else None,
         collect_telemetry=collect_telemetry,
+        num_groups=num_groups,
     )
     carry_specs = _sharded_carry_specs(env, axis_name)
     params_spec = _params_shard_spec(lowrank, axis_name)
     lane = P(axis_name)
 
-    def sh_init_local(params_shard, key, stats):
-        # GLOBAL lane ids seed the per-lane PRNG chains (same key on every
-        # shard): the sharded evaluation reproduces the unsharded one,
-        # whatever the topology
-        n_local = _params_popsize(params_shard)
-        carry, params_cast = init_fn(
-            params_shard, key, stats, global_lane_ids(axis_name, n_local)
-        )
-        lane_ids = jnp.arange(n_local, dtype=jnp.int32)  # LOCAL buffer ids
-        scores_buf = jnp.zeros(n_local, dtype=jnp.float32)
-        eps_buf = jnp.zeros(n_local, dtype=jnp.int32)
-        return _expand_shard_scalars(carry), params_cast, lane_ids, scores_buf, eps_buf
+    if num_groups > 1:
+        # group ids ride in as a 4th lane-sharded input; each shard seeds
+        # its partial per-group sums from its own lanes (psum'd at finalize)
+        def sh_init_local(params_shard, groups_shard, key, stats):
+            n_local = _params_popsize(params_shard)
+            carry, params_cast = init_fn(
+                params_shard,
+                key,
+                stats,
+                global_lane_ids(axis_name, n_local),
+                groups_shard,
+            )
+            lane_ids = jnp.arange(n_local, dtype=jnp.int32)  # LOCAL buffer ids
+            scores_buf = jnp.zeros(n_local, dtype=jnp.float32)
+            eps_buf = jnp.zeros(n_local, dtype=jnp.int32)
+            return _expand_shard_scalars(carry), params_cast, lane_ids, scores_buf, eps_buf
 
-    sh_init = jax.jit(
-        jax.shard_map(
-            sh_init_local,
-            mesh=mesh,
-            in_specs=(params_spec, P(), P()),
-            out_specs=(carry_specs, params_spec, lane, lane, lane),
-            check_vma=False,
+        sh_init = jax.jit(
+            jax.shard_map(
+                sh_init_local,
+                mesh=mesh,
+                in_specs=(params_spec, lane, P(), P()),
+                out_specs=(carry_specs, params_spec, lane, lane, lane),
+                check_vma=False,
+            )
         )
-    )
+    else:
+
+        def sh_init_local(params_shard, key, stats):
+            # GLOBAL lane ids seed the per-lane PRNG chains (same key on
+            # every shard): the sharded evaluation reproduces the unsharded
+            # one, whatever the topology
+            n_local = _params_popsize(params_shard)
+            carry, params_cast = init_fn(
+                params_shard, key, stats, global_lane_ids(axis_name, n_local)
+            )
+            lane_ids = jnp.arange(n_local, dtype=jnp.int32)  # LOCAL buffer ids
+            scores_buf = jnp.zeros(n_local, dtype=jnp.float32)
+            eps_buf = jnp.zeros(n_local, dtype=jnp.int32)
+            return _expand_shard_scalars(carry), params_cast, lane_ids, scores_buf, eps_buf
+
+        sh_init = jax.jit(
+            jax.shard_map(
+                sh_init_local,
+                mesh=mesh,
+                in_specs=(params_spec, P(), P()),
+                out_specs=(carry_specs, params_spec, lane, lane, lane),
+                check_vma=False,
+            )
+        )
 
     chunk_cache: dict = {}
 
@@ -1749,6 +2110,8 @@ def run_vectorized_rollout_compacting_sharded(
     return_per_shard_steps: bool = False,
     stats_sync: bool = False,
     telemetry: bool = True,
+    groups=None,
+    num_groups: int = 1,
 ) -> RolloutResult:
     """``run_vectorized_rollout_compacting`` with the population sharded over
     ``mesh[axis_name]``: each device narrows ITS working set as its lanes
@@ -1782,6 +2145,12 @@ def run_vectorized_rollout_compacting_sharded(
         max_t = min(max_t, int(episode_length))
     hard_cap = max_t * int(num_episodes) + 1
 
+    num_groups = int(num_groups)
+    if num_groups > 1 and groups is None:
+        raise ValueError("num_groups > 1 requires a groups array of per-solution ids")
+    if not (telemetry and num_groups > 1):
+        groups, num_groups = None, 1
+
     sh_init, sh_chunk, sh_compact, sh_finalize = _compacting_sharded_fns(
         env,
         policy,
@@ -1798,6 +2167,7 @@ def run_vectorized_rollout_compacting_sharded(
         isinstance(params_batch, LowRankParamsBatch),
         bool(stats_sync),
         bool(telemetry),
+        num_groups,
     )
 
     if allowed_widths is None:
@@ -1814,7 +2184,12 @@ def run_vectorized_rollout_compacting_sharded(
         allowed_widths = tuple(sorted(int(w) for w in allowed_widths if w < n_local))
 
     stats0 = stats
-    carry, params, lane_ids, scores_buf, eps_buf = sh_init(params_batch, key, stats)
+    if num_groups > 1:
+        carry, params, lane_ids, scores_buf, eps_buf = sh_init(
+            params_batch, jnp.asarray(groups, dtype=jnp.int32), key, stats
+        )
+    else:
+        carry, params, lane_ids, scores_buf, eps_buf = sh_init(params_batch, key, stats)
 
     if prewarm:
         # compile chunk + finalize at every width and every (from, to)

@@ -61,6 +61,8 @@ class VecNE(NEProblem):
         obs_norm_sync: str = "cohort",
         compact_config: Optional[dict] = None,
         refill_config: Optional[dict] = None,
+        solution_groups=None,
+        slo=None,
         compute_dtype=None,
         initial_bounds=(-0.00001, 0.00001),
         seed: Optional[int] = None,
@@ -130,6 +132,33 @@ class VecNE(NEProblem):
             if unknown:
                 raise ValueError(f"Unknown refill_config keys: {sorted(unknown)}")
         self._refill_config = dict(refill_config or {})
+        # per-group telemetry (ISSUE 15): one small int id per solution maps
+        # it to an accounting group (tenant, island, ...); the rollout
+        # engines segment_sum env-steps/episodes/capacity/refill/queue-wait
+        # per group INSIDE the same jitted programs, so multi-tenant
+        # occupancy/fairness accounting costs no extra host syncs
+        if solution_groups is not None:
+            g = np.asarray(solution_groups, dtype=np.int32)
+            if g.ndim != 1 or g.size == 0:
+                raise ValueError(
+                    "solution_groups must be a non-empty 1-D array of group ids"
+                )
+            if int(g.min()) < 0:
+                raise ValueError("solution_groups ids must be >= 0")
+            self._solution_groups = g
+            self._num_groups = int(g.max()) + 1
+        else:
+            self._solution_groups = None
+            self._num_groups = 1
+        # SLO watchdog (observability/slo.py): declarative rules evaluated
+        # against each generation's decoded telemetry; verdicts surface as
+        # slo_ok / slo_violations status keys (logger columns for free)
+        if slo is not None:
+            from ..observability.slo import SLOWatchdog
+
+            self._slo = slo if isinstance(slo, SLOWatchdog) else SLOWatchdog(slo)
+        else:
+            self._slo = None
         # tuned-config cache wiring (observability/timings.py): when the
         # refill / compaction knobs are NOT passed explicitly, eval setup
         # consults the checked-in tuned_configs.json for this
@@ -182,6 +211,7 @@ class VecNE(NEProblem):
         # basis_capture: the decode is a ~24-byte transfer, never a stall)
         self._pending_telemetry = None
         self._last_telemetry = None
+        self._last_group_telemetry = None
 
         super().__init__(
             "max",
@@ -216,6 +246,14 @@ class VecNE(NEProblem):
     @property
     def obs_norm(self) -> RunningNorm:
         return self._obs_norm
+
+    @property
+    def last_group_telemetry(self):
+        """The previous generation's decoded per-group telemetry
+        (:class:`~evotorch_tpu.observability.GroupTelemetry`; lag-by-one,
+        None until telemetry has flowed) — what MetricsHub consumers feed
+        to ``emit(..., telemetry=...)``."""
+        return self._last_group_telemetry
 
     def _take_prewarm(self, popsize: int) -> bool:
         """Prewarm once per population size (not once ever): a small warm-up
@@ -309,11 +347,15 @@ class VecNE(NEProblem):
         previous one (already materialized — see the constructor note)."""
         if telemetry is None:
             return
-        from ..observability import EvalTelemetry
+        from ..observability import GroupTelemetry
 
         prev, self._pending_telemetry = self._pending_telemetry, telemetry
         if prev is not None:
-            self._last_telemetry = EvalTelemetry.from_array(prev)
+            # ONE metered fetch per generation, whatever G is: the per-group
+            # matrix is decoded once, and the global figures derive from it
+            gt = GroupTelemetry.from_array(prev)
+            self._last_group_telemetry = gt
+            self._last_telemetry = gt.total()
 
     def _report_counters(self, batch) -> dict:
         status = {
@@ -325,6 +367,15 @@ class VecNE(NEProblem):
             # previous generation's figures (lag-by-one; shapes are identical
             # generation to generation, so the diagnostics are current)
             status.update(self._last_telemetry.as_status(prefix="eval_"))
+        if self._last_group_telemetry is not None:
+            # per-group keys (eval_g{g}_occupancy/...), emitted only at G>1
+            status.update(self._last_group_telemetry.as_status(prefix="eval_"))
+            if self._slo is not None:
+                status.update(
+                    self._slo.check(
+                        self._last_group_telemetry, status=status
+                    ).as_status()
+                )
         if self._tuned_config_source is not None:
             # where the schedule knobs came from: "override" (explicit
             # config), "cache" (tuned_configs.json hit) or "fallback"
@@ -333,7 +384,7 @@ class VecNE(NEProblem):
         return status
 
     # ------------------------------------------------------------ evaluation
-    def _rollout_batch(self, values: jnp.ndarray, key) -> tuple:
+    def _rollout_batch(self, values: jnp.ndarray, key, groups=None) -> tuple:
         kwargs = dict(
             num_episodes=self._num_episodes,
             episode_length=self._episode_length,
@@ -343,6 +394,11 @@ class VecNE(NEProblem):
             action_noise_stdev=self._action_noise_stdev,
             compute_dtype=self._compute_dtype,
         )
+        if groups is not None:
+            # num_groups stays the problem-GLOBAL count: sub-batch matrices
+            # share the row space, so they stay addable
+            kwargs["groups"] = groups
+            kwargs["num_groups"] = self._num_groups
         if self._eval_mode == "episodes_compact":
             return run_vectorized_rollout_compacting(
                 self._env, self._policy, values, key, self._obs_norm.stats,
@@ -403,24 +459,41 @@ class VecNE(NEProblem):
             # the rollout engine — the dense (N, L) matrix is never built
             values = jnp.asarray(values)
         n = len(batch)
+        groups = self._check_solution_groups(n)
         if self._max_num_envs is not None and n > self._max_num_envs:
             # workload splitting (reference vecgymne.py:440-455): evaluate in
             # sub-batches of at most max_num_envs environments
             scores = []
             for start in range(0, n, self._max_num_envs):
+                stop = min(start + self._max_num_envs, n)
                 piece = (
-                    values.take(jnp.arange(start, min(start + self._max_num_envs, n)))
+                    values.take(jnp.arange(start, stop))
                     if isinstance(values, LowRankParamsBatch)
-                    else values[start : start + self._max_num_envs]
+                    else values[start:stop]
                 )
-                result = self._rollout_batch(piece, self.next_rng_key())
+                result = self._rollout_batch(
+                    piece,
+                    self.next_rng_key(),
+                    groups=None if groups is None else groups[start:stop],
+                )
                 scores.append(result.scores)
                 self._consume_rollout_side_effects(result)
             batch.set_evals(jnp.concatenate(scores))
             return
-        result = self._rollout_batch(values, self.next_rng_key())
+        result = self._rollout_batch(values, self.next_rng_key(), groups=groups)
         self._consume_rollout_side_effects(result)
         batch.set_evals(result.scores)
+
+    def _check_solution_groups(self, popsize: int):
+        """The configured per-solution group ids, validated against the
+        batch size (None when per-group accounting is off)."""
+        groups = self._solution_groups
+        if groups is not None and len(groups) != popsize:
+            raise ValueError(
+                f"solution_groups maps {len(groups)} solutions but the batch"
+                f" holds {popsize}"
+            )
+        return groups
 
     def _consume_rollout_side_effects(self, result):
         # counters accumulate as device scalars: the addition enqueues a tiny
@@ -511,6 +584,12 @@ class VecNE(NEProblem):
                     kwargs["refill_width"] = int(self._refill_config["width"])
                 if self._refill_config.get("period") is not None:
                     kwargs["refill_period"] = int(self._refill_config["period"])
+            if self._solution_groups is not None:
+                # the helper pads the ids alongside the population rows;
+                # per-mesh memoization is safe — the mapping is fixed at
+                # construction
+                kwargs["groups"] = self._solution_groups
+                kwargs["num_groups"] = self._num_groups
             evaluator = memo[mesh] = make_sharded_rollout_evaluator(
                 self._env,
                 self._policy,
@@ -545,6 +624,7 @@ class VecNE(NEProblem):
 
         stats = self._obs_norm.stats
         obsnorm = self._observation_normalization
+        groups = self._check_solution_groups(n)
         if self._eval_mode == "episodes_compact":
             from ..parallel.mesh import mesh_label
 
@@ -572,6 +652,8 @@ class VecNE(NEProblem):
                 compute_dtype=self._compute_dtype,
                 prewarm=self._take_prewarm(n),
                 stats_sync=(obsnorm and self._obs_norm_sync == "step"),
+                groups=groups,
+                num_groups=self._num_groups if groups is not None else 1,
                 **self._sharded_compact_config(n_shards, n, mesh_label(mesh)),
             )
             if obsnorm:

@@ -5,9 +5,12 @@ Three cooperating pieces (docs/observability.md has the full catalog):
 - :mod:`~evotorch_tpu.observability.devicemetrics` — ON-DEVICE metric
   accumulators: env-steps, episodes, lane capacity (occupancy), refill
   events and queue-wait lane-steps, accumulated inside the existing
-  rollout ``lax.while_loop`` carries and returned as ONE packed ``(6,)``
-  int32 vector in the same device->host transfer as the scores. Zero
-  extra dispatches, zero retraces (sentinel-asserted in the fast tier).
+  rollout ``lax.while_loop`` carries and returned as ONE packed int32
+  array in the same device->host transfer as the scores. Zero extra
+  dispatches, zero retraces (sentinel-asserted in the fast tier). The v2
+  wire is a PER-GROUP ``(G, 14)`` matrix (segment-summed counters +
+  bucketed queue-wait histograms; ``GroupTelemetry`` decodes it, the v1
+  ``(6,)`` vector still decodes everywhere).
 - :mod:`~evotorch_tpu.observability.tracer` — a host-side span tracer
   emitting Chrome trace-event JSON loadable in Perfetto (ring-buffered;
   a no-op singleton when disabled). Spans cover ask/eval/tell in the
@@ -35,6 +38,15 @@ Three cooperating pieces (docs/observability.md has the full catalog):
   consumer reports ``tuned_config_source`` provenance. Filled by the
   autotuner: ``python -m evotorch_tpu.observability.autotune``
   (:mod:`~evotorch_tpu.observability.autotune`).
+- :mod:`~evotorch_tpu.observability.metricshub` — streaming export of the
+  decoded telemetry + counter registry as schema-versioned JSONL (manifest
+  first line) or Prometheus text (``.prom`` suffix); wired to
+  ``EVOTORCH_METRICS=path`` in bench.py and the curve runner.
+- :mod:`~evotorch_tpu.observability.slo` — declarative SLO watchdog
+  (per-group occupancy floor, starvation ceiling off the top queue-wait
+  bucket, steady_compiles == 0, min progress) surfaced as searcher status
+  keys (``VecNEProblem(slo=...)``) and the tpu_window.sh battery verdict
+  (``python -m evotorch_tpu.observability.slo --check-bench``).
 """
 
 from .compilecache import (  # noqa: F401
@@ -43,9 +55,36 @@ from .compilecache import (  # noqa: F401
 )
 from .devicemetrics import (  # noqa: F401
     EvalTelemetry,
+    GROUP_TELEMETRY_WIDTH,
+    GroupTelemetry,
+    QUEUE_WAIT_BUCKET_EDGES,
+    QUEUE_WAIT_BUCKETS,
+    TELEMETRY_SCHEMA_VERSION,
     TELEMETRY_WIDTH,
     pack_eval_telemetry,
+    pack_group_telemetry,
+    queue_wait_bucket_index,
 )
+# MetricsHub / SLO names resolve lazily (module __getattr__ below): an
+# eager `from .slo import ...` here would trip runpy's double-import
+# warning every time the CLI runs as `python -m evotorch_tpu.observability.slo`
+_LAZY_EXPORTS = {
+    "MetricsHub": "metricshub",
+    "Rule": "slo",
+    "SLOReport": "slo",
+    "SLOWatchdog": "slo",
+}
+
+
+def __getattr__(name):
+    submodule = _LAZY_EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(f".{submodule}", __name__), name)
+    globals()[name] = value
+    return value
 from .programs import (  # noqa: F401
     DonationReport,
     ProgramLedger,
@@ -96,8 +135,19 @@ __all__ = [
     "cache_stats",
     "enable_persistent_cache",
     "EvalTelemetry",
+    "GroupTelemetry",
+    "GROUP_TELEMETRY_WIDTH",
+    "QUEUE_WAIT_BUCKETS",
+    "QUEUE_WAIT_BUCKET_EDGES",
+    "TELEMETRY_SCHEMA_VERSION",
     "TELEMETRY_WIDTH",
     "pack_eval_telemetry",
+    "pack_group_telemetry",
+    "queue_wait_bucket_index",
+    "MetricsHub",
+    "Rule",
+    "SLOReport",
+    "SLOWatchdog",
     "CounterRegistry",
     "counters",
     "ensure_compile_counter",

@@ -1,16 +1,27 @@
-"""On-device eval telemetry: the packed counter vector and its host decode.
+"""On-device eval telemetry: packed counter vectors/matrices and host decode.
 
 The zero-sync contract: every rollout engine accumulates its metrics as a
 few int32 scalars INSIDE the loop carry it already runs (no new programs,
 no host round-trips, no retraces — sentinel-asserted), and packs them into
-ONE ``(TELEMETRY_WIDTH,)`` int32 vector at the end of the jitted program.
-The vector rides out in ``RolloutResult.telemetry`` next to the scores, so
-fetching the whole telemetry of an evaluation is a single ~24-byte
-device->host transfer of an already-materialized output — and every slot is
-ADDITIVE, so sharded evaluations psum the vector and sub-batched
-evaluations just add them.
+ONE int32 output at the end of the jitted program. The output rides out in
+``RolloutResult.telemetry`` next to the scores, so fetching the whole
+telemetry of an evaluation is a single small device->host transfer of an
+already-materialized output — and every slot is ADDITIVE, so sharded
+evaluations psum it and sub-batched evaluations just add.
 
-Slots (``pack_eval_telemetry`` builds, :class:`EvalTelemetry` decodes):
+Two wire formats share the slot layout:
+
+* **v1** — one global ``(TELEMETRY_WIDTH,)`` vector (the PR-8 format;
+  ``pack_eval_telemetry`` builds it, :class:`EvalTelemetry` decodes it).
+* **v2** — a per-group ``(G, GROUP_TELEMETRY_WIDTH)`` matrix: the first
+  ``TELEMETRY_WIDTH`` columns are the v1 slots *per group id*, the
+  remaining ``QUEUE_WAIT_BUCKETS`` columns are a log-bucketed queue-wait
+  histogram per group (``pack_group_telemetry`` builds it,
+  :class:`GroupTelemetry` decodes it; ``TELEMETRY_SCHEMA_VERSION`` names
+  the format in metrics manifests). Column-summing the counter block of a
+  v2 matrix reproduces the v1 global numbers exactly.
+
+Slots (column order is the wire format — append only):
 
 ===================  =======================================================
 ``env_steps``        counted env interactions (active lanes x steps)
@@ -27,6 +38,15 @@ Slots (``pack_eval_telemetry`` builds, :class:`EvalTelemetry` decodes):
                      starvation-accounting numerator
 ===================  =======================================================
 
+Histogram buckets (columns ``TELEMETRY_WIDTH ..``): each refilled item's
+per-item wait (loop steps between the lane going idle and the refill that
+reused it) increments one of ``QUEUE_WAIT_BUCKETS`` log-spaced buckets with
+lower edges ``QUEUE_WAIT_BUCKET_EDGES`` — bucket 0 counts zero-wait
+refills, bucket ``b`` counts waits in ``[2^(b-1), 2^b - 1]``, the last
+bucket is the overflow (>= 64 steps). ``GroupTelemetry.queue_wait_quantile``
+reads p50/p99 tail wait off the buckets without ever materializing per-item
+waits on the host.
+
 Derived: ``occupancy = env_steps / capacity`` (1.0 for the budget contract
 by construction; the idle-lane waste of plain ``episodes`` and the
 work-conservation of ``episodes_refill`` are directly visible here), and
@@ -35,13 +55,25 @@ work-conservation of ``episodes_refill`` are directly visible here), and
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
 
 import numpy as np
 
 from .registry import counters
 
-__all__ = ["TELEMETRY_WIDTH", "pack_eval_telemetry", "EvalTelemetry"]
+__all__ = [
+    "TELEMETRY_WIDTH",
+    "GROUP_TELEMETRY_WIDTH",
+    "QUEUE_WAIT_BUCKETS",
+    "QUEUE_WAIT_BUCKET_EDGES",
+    "TELEMETRY_SCHEMA_VERSION",
+    "pack_eval_telemetry",
+    "pack_group_telemetry",
+    "queue_wait_bucket_index",
+    "EvalTelemetry",
+    "GroupTelemetry",
+]
 
 #: packed vector layout (order is the wire format — append only)
 _SLOTS = (
@@ -54,6 +86,23 @@ _SLOTS = (
 )
 TELEMETRY_WIDTH = len(_SLOTS)
 
+#: queue-wait histogram: log-spaced int32 buckets. Bucket 0 = zero-wait
+#: refills; bucket b (1..6) = waits in [2^(b-1), 2^b - 1]; bucket 7 =
+#: overflow (>= 64 loop steps of waiting).
+QUEUE_WAIT_BUCKET_EDGES = (1, 2, 4, 8, 16, 32, 64)
+QUEUE_WAIT_BUCKETS = len(QUEUE_WAIT_BUCKET_EDGES) + 1
+
+#: v2 row width: the v1 counter block + the histogram block
+GROUP_TELEMETRY_WIDTH = TELEMETRY_WIDTH + QUEUE_WAIT_BUCKETS
+
+#: recorded in metrics manifests; bump on any wire-format change
+TELEMETRY_SCHEMA_VERSION = 2
+
+#: inclusive UPPER edge of each non-overflow bucket (host-side quantile
+#: decode, Prometheus style: a quantile inside bucket b reports the bucket's
+#: upper edge); the overflow bucket reports its lower edge
+_BUCKET_UPPER_EDGES = (0, 1, 3, 7, 15, 31, 63, 64)
+
 
 def pack_eval_telemetry(
     *,
@@ -64,8 +113,8 @@ def pack_eval_telemetry(
     refill_events=0,
     queue_wait=0,
 ):
-    """Stack the counters into the ``(TELEMETRY_WIDTH,)`` int32 wire vector
-    (call inside jit, on the final carry's scalars)."""
+    """Stack the counters into the ``(TELEMETRY_WIDTH,)`` int32 v1 wire
+    vector (call inside jit, on the final carry's scalars)."""
     import jax.numpy as jnp
 
     return jnp.stack(
@@ -78,6 +127,34 @@ def pack_eval_telemetry(
             jnp.asarray(queue_wait, dtype=jnp.int32),
         ]
     )
+
+
+def pack_group_telemetry(group_counts, hist=None):
+    """Concatenate a ``(G, TELEMETRY_WIDTH)`` counter block and a
+    ``(G, QUEUE_WAIT_BUCKETS)`` histogram block into the
+    ``(G, GROUP_TELEMETRY_WIDTH)`` int32 v2 wire matrix (call inside jit).
+    ``hist=None`` emits all-zero buckets (the non-refill engines)."""
+    import jax.numpy as jnp
+
+    group_counts = jnp.asarray(group_counts, dtype=jnp.int32)
+    if hist is None:
+        hist = jnp.zeros(
+            (group_counts.shape[0], QUEUE_WAIT_BUCKETS), dtype=jnp.int32
+        )
+    return jnp.concatenate(
+        [group_counts, jnp.asarray(hist, dtype=jnp.int32)], axis=1
+    )
+
+
+def queue_wait_bucket_index(waits):
+    """Map int32 wait values to histogram bucket indices (inside jit).
+    ``sum(wait >= edge)`` over the log-spaced lower edges — branch-free and
+    integer-exact."""
+    import jax.numpy as jnp
+
+    edges = jnp.asarray(QUEUE_WAIT_BUCKET_EDGES, dtype=jnp.int32)
+    waits = jnp.asarray(waits, dtype=jnp.int32)
+    return jnp.sum(waits[..., None] >= edges, axis=-1)
 
 
 @dataclass(frozen=True)
@@ -93,17 +170,24 @@ class EvalTelemetry:
 
     @classmethod
     def from_array(cls, array) -> "EvalTelemetry":
-        """Decode a packed vector (device or host). The one device->host
-        transfer of the telemetry path — metered as a ``telemetry_fetches``
-        registry count so "zero extra transfers" stays auditable."""
+        """Decode a packed v1 ``(TELEMETRY_WIDTH,)`` vector OR a v2
+        ``(G, GROUP_TELEMETRY_WIDTH)`` matrix (column-summed to the global
+        totals). The one device->host transfer of the telemetry path —
+        metered as a ``telemetry_fetches`` registry count so "zero extra
+        transfers" stays auditable."""
         values = np.asarray(array)
-        if values.shape != (TELEMETRY_WIDTH,):
-            raise ValueError(
-                f"expected a ({TELEMETRY_WIDTH},) telemetry vector, got shape"
-                f" {values.shape}"
-            )
-        counters.increment("telemetry_fetches")
-        return cls(**{name: int(values[i]) for i, name in enumerate(_SLOTS)})
+        if values.shape == (TELEMETRY_WIDTH,):
+            counters.increment("telemetry_fetches")
+            return cls(**{name: int(values[i]) for i, name in enumerate(_SLOTS)})
+        if values.ndim == 2 and values.shape[1] == GROUP_TELEMETRY_WIDTH:
+            counters.increment("telemetry_fetches")
+            totals = values[:, :TELEMETRY_WIDTH].sum(axis=0)
+            return cls(**{name: int(totals[i]) for i, name in enumerate(_SLOTS)})
+        raise ValueError(
+            f"expected a ({TELEMETRY_WIDTH},) telemetry vector or a"
+            f" (G, {GROUP_TELEMETRY_WIDTH}) per-group matrix, got shape"
+            f" {values.shape}"
+        )
 
     def __add__(self, other: "EvalTelemetry") -> "EvalTelemetry":
         if not isinstance(other, EvalTelemetry):
@@ -138,3 +222,149 @@ class EvalTelemetry:
             f"occupancy={self.occupancy:.4f} lane_width={self.lane_width} "
             f"refill_events={self.refill_events} queue_wait={self.queue_wait}"
         )
+
+
+@dataclass(frozen=True)
+class GroupTelemetry:
+    """Host-side decode of a v2 per-group ``(G, GROUP_TELEMETRY_WIDTH)``
+    telemetry matrix — per-group counters plus queue-wait histograms.
+
+    Rows are ADDITIVE like the v1 slots: sharded matrices psum, sub-batched
+    matrices add (``__add__``). ``total()`` collapses to the v1 global
+    figures; ``group(g)`` reads one group's counters; the histogram
+    quantiles answer "what is this group's tail queue wait" without a
+    per-item host transfer.
+    """
+
+    data: np.ndarray = field(
+        default_factory=lambda: np.zeros(
+            (1, GROUP_TELEMETRY_WIDTH), dtype=np.int64
+        )
+    )
+
+    @classmethod
+    def from_array(cls, array) -> "GroupTelemetry":
+        """Decode a v2 matrix, or lift a v1 vector into a single-group
+        matrix with empty histogram buckets. Metered like
+        :meth:`EvalTelemetry.from_array`."""
+        values = np.asarray(array)
+        if values.shape == (TELEMETRY_WIDTH,):
+            row = np.zeros((1, GROUP_TELEMETRY_WIDTH), dtype=np.int64)
+            row[0, :TELEMETRY_WIDTH] = values
+            counters.increment("telemetry_fetches")
+            return cls(data=row)
+        if values.ndim == 2 and values.shape[1] == GROUP_TELEMETRY_WIDTH:
+            counters.increment("telemetry_fetches")
+            return cls(data=np.asarray(values, dtype=np.int64).copy())
+        raise ValueError(
+            f"expected a (G, {GROUP_TELEMETRY_WIDTH}) per-group telemetry"
+            f" matrix or a ({TELEMETRY_WIDTH},) v1 vector, got shape"
+            f" {values.shape}"
+        )
+
+    @property
+    def num_groups(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def hist(self) -> np.ndarray:
+        """The ``(G, QUEUE_WAIT_BUCKETS)`` queue-wait histogram block."""
+        return self.data[:, TELEMETRY_WIDTH:]
+
+    def group(self, g: int) -> EvalTelemetry:
+        """One group's counters as an :class:`EvalTelemetry` (no fetch
+        metering — the matrix was already fetched)."""
+        row = self.data[g]
+        return EvalTelemetry(
+            **{name: int(row[i]) for i, name in enumerate(_SLOTS)}
+        )
+
+    def total(self) -> EvalTelemetry:
+        """Column-sum to the v1 global figures (no fetch metering)."""
+        totals = self.data[:, :TELEMETRY_WIDTH].sum(axis=0)
+        return EvalTelemetry(
+            **{name: int(totals[i]) for i, name in enumerate(_SLOTS)}
+        )
+
+    def __add__(self, other: "GroupTelemetry") -> "GroupTelemetry":
+        if not isinstance(other, GroupTelemetry):
+            return NotImplemented
+        a, b = self.data, other.data
+        if a.shape[0] != b.shape[0]:
+            # sub-batches may see different group counts; pad to the max
+            g = max(a.shape[0], b.shape[0])
+            pa = np.zeros((g, GROUP_TELEMETRY_WIDTH), dtype=np.int64)
+            pb = np.zeros((g, GROUP_TELEMETRY_WIDTH), dtype=np.int64)
+            pa[: a.shape[0]] = a
+            pb[: b.shape[0]] = b
+            a, b = pa, pb
+        return GroupTelemetry(data=a + b)
+
+    def queue_wait_quantile(
+        self, q: float, group: Optional[int] = None
+    ) -> float:
+        """Approximate wait quantile (in loop steps) off the bucketed
+        histogram, Prometheus style: walk the cumulative counts and report
+        the inclusive upper edge of the bucket containing the quantile (the
+        overflow bucket reports its lower edge, 64). 0.0 when no refills
+        were histogrammed."""
+        hist = self.hist if group is None else self.hist[group : group + 1]
+        hist = np.asarray(hist, dtype=np.int64).sum(axis=0)
+        total = int(hist.sum())
+        if total == 0:
+            return 0.0
+        target = q * total
+        cum = 0
+        for b in range(QUEUE_WAIT_BUCKETS):
+            cum += int(hist[b])
+            if cum >= target:
+                return float(_BUCKET_UPPER_EDGES[b])
+        return float(_BUCKET_UPPER_EDGES[-1])
+
+    def starvation_share(self, group: Optional[int] = None) -> float:
+        """Share of refilled items that landed in the overflow (>= 64 step
+        wait) bucket — the SLO watchdog's starvation figure (0.0 without
+        histogrammed refills)."""
+        hist = self.hist if group is None else self.hist[group : group + 1]
+        hist = np.asarray(hist, dtype=np.int64).sum(axis=0)
+        total = int(hist.sum())
+        return (int(hist[-1]) / total) if total else 0.0
+
+    def as_status(self, prefix: str = "eval_") -> dict:
+        """Per-group status keys (``{prefix}g{g}_...``) next to the global
+        figures — only emitted when there is more than one group, so the
+        G=1 status dict stays exactly the v1 shape."""
+        out = {}
+        if self.num_groups > 1:
+            for g in range(self.num_groups):
+                row = self.group(g)
+                out[f"{prefix}g{g}_occupancy"] = round(row.occupancy, 6)
+                out[f"{prefix}g{g}_env_steps"] = row.env_steps
+                out[f"{prefix}g{g}_episodes"] = row.episodes
+                out[f"{prefix}g{g}_queue_wait"] = row.queue_wait
+        return out
+
+    def summary(self) -> str:
+        tot = self.total()
+        parts = [f"groups={self.num_groups}", tot.summary()]
+        if int(self.hist.sum()):
+            parts.append(
+                f"queue_wait_p50={self.queue_wait_quantile(0.5):g}"
+                f" p99={self.queue_wait_quantile(0.99):g}"
+            )
+        return " ".join(parts)
+
+    def to_rows(self) -> Tuple[dict, ...]:
+        """JSON-safe per-group rows for the MetricsHub stream."""
+        rows = []
+        for g in range(self.num_groups):
+            row = self.group(g)
+            rows.append(
+                {
+                    "group": g,
+                    **{name: getattr(row, name) for name in _SLOTS},
+                    "occupancy": round(row.occupancy, 6),
+                    "queue_wait_hist": [int(v) for v in self.hist[g]],
+                }
+            )
+        return tuple(rows)

@@ -1,0 +1,311 @@
+"""Declarative SLO watchdog over the decoded per-group telemetry.
+
+Rules are plain declarative records (``Rule`` dataclasses or equivalent
+dicts) evaluated once per generation against a decoded
+:class:`~evotorch_tpu.observability.devicemetrics.GroupTelemetry` matrix —
+the lag-by-one wire that the engines already emit, so checking SLOs costs
+zero extra device syncs.  Four rule kinds cover the fairness/starvation
+contract the multi-tenant eval service and island PBT need:
+
+``occupancy_floor``
+    every group's (or one group's) lane occupancy must be >= ``threshold``.
+    Groups that were allotted zero capacity are skipped (vacuously true).
+``starvation_ceiling``
+    the share of refills landing in the TOP queue-wait bucket (waits >=
+    the last histogram edge) must be <= ``threshold`` — the on-device
+    starvation figure, per group or global.
+``no_steady_compiles``
+    the ``steady_compiles`` status key (retrace sentinel) must be 0.
+    Skipped when the key is absent from ``status``.
+``min_progress``
+    every group's (or one group's) env-step count must be >= ``threshold``
+    — a starved tenant shows up here even when its occupancy is undefined.
+
+The watchdog surfaces as searcher status keys (``slo_ok`` /
+``slo_violations`` / ``slo_detail``) via ``VecNEProblem(slo=...)``, and as
+a battery verdict via the CLI::
+
+    python -m evotorch_tpu.observability.slo --check-bench bench.log \
+        --verdict-out slo_verdict.txt
+
+which reads the LAST JSON line of a bench log (the bench.py output
+contract), applies the battery default rules (steady_compiles == 0 plus a
+global occupancy floor), writes a one-word ``pass``/``fail`` verdict file
+for tpu_watch.sh, prints a JSON verdict line, and exits 0/1.
+
+See docs/observability.md "Per-group telemetry & SLOs".
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Optional, Tuple, Union
+
+from .devicemetrics import GroupTelemetry
+
+__all__ = [
+    "Rule",
+    "RULE_KINDS",
+    "SLOReport",
+    "SLOWatchdog",
+    "DEFAULT_BENCH_RULES",
+]
+
+
+RULE_KINDS = (
+    "occupancy_floor",
+    "starvation_ceiling",
+    "no_steady_compiles",
+    "min_progress",
+)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One declarative SLO rule.
+
+    ``group=None`` means "every group" for the per-group kinds (and the
+    global figure for ``starvation_ceiling``); an int pins the rule to a
+    single group row.
+    """
+
+    kind: str
+    threshold: float = 0.0
+    group: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in RULE_KINDS:
+            raise ValueError(
+                f"unknown SLO rule kind {self.kind!r}; expected one of {RULE_KINDS}"
+            )
+
+
+@dataclass(frozen=True)
+class SLOReport:
+    """Outcome of one watchdog evaluation (one generation)."""
+
+    ok: bool
+    violations: Tuple[str, ...] = field(default_factory=tuple)
+    checked: int = 0
+
+    def as_status(self) -> Dict[str, Any]:
+        status: Dict[str, Any] = {
+            "slo_ok": bool(self.ok),
+            "slo_violations": len(self.violations),
+        }
+        if self.violations:
+            status["slo_detail"] = "; ".join(self.violations)
+        return status
+
+    def summary(self) -> str:
+        if self.ok:
+            return f"SLO ok ({self.checked} rules)"
+        return f"SLO FAIL ({len(self.violations)}/{self.checked}): " + "; ".join(
+            self.violations
+        )
+
+
+def _coerce_rule(rule: Union[Rule, Dict[str, Any]]) -> Rule:
+    if isinstance(rule, Rule):
+        return rule
+    if isinstance(rule, dict):
+        return Rule(**rule)
+    raise TypeError(f"SLO rule must be a Rule or a dict, got {type(rule).__name__}")
+
+
+class SLOWatchdog:
+    """Evaluates a fixed rule set against per-group telemetry each call."""
+
+    def __init__(self, rules: Optional[Iterable[Union[Rule, dict]]] = None):
+        if rules is None or rules is True:
+            rules = DEFAULT_RULES
+        self.rules: Tuple[Rule, ...] = tuple(_coerce_rule(r) for r in rules)
+
+    def __repr__(self):
+        return f"SLOWatchdog(rules={list(self.rules)!r})"
+
+    # ------------------------------------------------------------ evaluation
+    def check(
+        self,
+        telemetry: Optional[GroupTelemetry],
+        *,
+        status: Optional[Dict[str, Any]] = None,
+    ) -> SLOReport:
+        """Evaluate every rule; telemetry=None checks only status-keyed rules."""
+        violations = []
+        checked = 0
+        for rule in self.rules:
+            outcome = self._check_rule(rule, telemetry, status or {})
+            if outcome is None:  # rule not applicable (no data) — skipped
+                continue
+            checked += 1
+            if outcome:
+                violations.append(outcome if isinstance(outcome, str) else str(outcome))
+        return SLOReport(
+            ok=not violations, violations=tuple(violations), checked=checked
+        )
+
+    def _check_rule(self, rule, telemetry, status):
+        """Returns None (skipped), False (passed) or a violation string."""
+        if rule.kind == "no_steady_compiles":
+            compiles = status.get("steady_compiles")
+            if compiles is None:
+                return None
+            if int(compiles) > 0:
+                return f"steady_compiles={int(compiles)} (expected 0)"
+            return False
+        if telemetry is None:
+            return None
+        groups = (
+            range(telemetry.num_groups) if rule.group is None else (rule.group,)
+        )
+        if rule.kind == "occupancy_floor":
+            failed = []
+            for g in groups:
+                t = telemetry.group(g)
+                if t.capacity <= 0:  # no lanes allotted: vacuously true
+                    continue
+                if t.occupancy < rule.threshold:
+                    failed.append(f"g{g}={t.occupancy:.3f}")
+            if failed:
+                return f"occupancy < {rule.threshold:g}: " + ", ".join(failed)
+            return False
+        if rule.kind == "starvation_ceiling":
+            targets = (None,) if rule.group is None else (rule.group,)
+            failed = []
+            for g in targets:
+                share = telemetry.starvation_share(group=g)
+                if share > rule.threshold:
+                    label = "global" if g is None else f"g{g}"
+                    failed.append(f"{label}={share:.3f}")
+            if failed:
+                return f"starvation > {rule.threshold:g}: " + ", ".join(failed)
+            return False
+        if rule.kind == "min_progress":
+            failed = []
+            for g in groups:
+                steps = int(telemetry.group(g).env_steps)
+                if steps < rule.threshold:
+                    failed.append(f"g{g}={steps}")
+            if failed:
+                return f"env_steps < {rule.threshold:g}: " + ", ".join(failed)
+            return False
+        raise AssertionError(rule.kind)  # unreachable: ctor validates
+
+
+#: defaults when ``VecNEProblem(slo=True)`` asks for a watchdog without
+#: spelling rules out: no silent retraces, nobody fully starved
+DEFAULT_RULES: Tuple[Rule, ...] = (
+    Rule("no_steady_compiles"),
+    Rule("starvation_ceiling", threshold=0.5),
+    Rule("min_progress", threshold=1),
+)
+
+#: battery-verdict defaults for ``--check-bench``: the flagship bench line
+#: must be retrace-free and show a sane primary-mode occupancy
+DEFAULT_BENCH_RULES: Tuple[Rule, ...] = (
+    Rule("no_steady_compiles"),
+    Rule("occupancy_floor", threshold=0.1),
+)
+
+
+# ---------------------------------------------------------------- bench CLI
+def check_bench_line(
+    line: Dict[str, Any], *, occupancy_floor: float = 0.1
+) -> SLOReport:
+    """Apply the battery rules to one decoded bench.py JSON line.
+
+    The bench line carries scalars, not a (G, K) matrix, so this reads the
+    top-level ``occupancy`` / ``steady_compiles`` keys (plus per-mode
+    occupancies under ``modes``) directly.
+    """
+    violations = []
+    checked = 0
+    compiles = line.get("steady_compiles")
+    if compiles is not None:
+        checked += 1
+        if int(compiles) > 0:
+            violations.append(f"steady_compiles={int(compiles)} (expected 0)")
+    occ = line.get("occupancy")
+    if occ is not None:
+        checked += 1
+        if float(occ) < occupancy_floor:
+            violations.append(f"occupancy={float(occ):.3f} < {occupancy_floor:g}")
+    modes = line.get("modes") or {}
+    for mode, rec in sorted(modes.items()):
+        mocc = rec.get("occupancy") if isinstance(rec, dict) else None
+        if mocc is None:
+            continue
+        checked += 1
+        if float(mocc) < occupancy_floor:
+            violations.append(
+                f"modes.{mode}.occupancy={float(mocc):.3f} < {occupancy_floor:g}"
+            )
+    return SLOReport(ok=not violations, violations=tuple(violations), checked=checked)
+
+
+def _last_json_line(path: str) -> Dict[str, Any]:
+    last = None
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw or not raw.startswith("{"):
+                continue
+            try:
+                last = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+    if last is None:
+        raise SystemExit(f"no JSON line found in {path}")
+    return last
+
+
+def _main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="SLO watchdog: verdict over a bench.py JSON log"
+    )
+    parser.add_argument(
+        "--check-bench",
+        metavar="LOG",
+        required=True,
+        help="bench log; the LAST JSON line is checked",
+    )
+    parser.add_argument(
+        "--occupancy-floor",
+        type=float,
+        default=0.1,
+        help="minimum acceptable occupancy, global and per mode (default 0.1)",
+    )
+    parser.add_argument(
+        "--verdict-out",
+        metavar="PATH",
+        default=None,
+        help="write a one-word pass/fail verdict file (read by tpu_watch.sh)",
+    )
+    args = parser.parse_args(argv)
+
+    line = _last_json_line(args.check_bench)
+    report = check_bench_line(line, occupancy_floor=args.occupancy_floor)
+    verdict = "pass" if report.ok else "fail"
+    if args.verdict_out:
+        with open(args.verdict_out, "w", encoding="utf-8") as fh:
+            fh.write(verdict + "\n")
+    print(
+        json.dumps(
+            {
+                "slo_verdict": verdict,
+                "slo_checked": report.checked,
+                "slo_violations": list(report.violations),
+                "source": args.check_bench,
+            },
+            sort_keys=True,
+        )
+    )
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -98,7 +98,13 @@ _NOOP = _NoopSpan()
 class SpanTracer:
     """Ring-buffered Chrome trace-event recorder (see the module docstring)."""
 
-    def __init__(self, *, capacity: int = DEFAULT_CAPACITY):
+    def __init__(
+        self,
+        *,
+        capacity: int = DEFAULT_CAPACITY,
+        flush_path: Optional[str] = None,
+        flush_secs: Optional[float] = None,
+    ):
         self._events: deque = deque(maxlen=int(capacity))
         self._meta: List[dict] = []  # thread-name metadata; never evicted
         self._t0 = time.perf_counter()
@@ -109,6 +115,13 @@ class SpanTracer:
         # the trace (the acquire is ~100ns against spans that are µs+)
         self._lock = threading.Lock()
         self._named: set = set()
+        # periodic ring-buffer flush (off unless both are set): a SIGKILLed
+        # long run keeps the last flushed window instead of losing the whole
+        # trace at the missed atexit hook
+        self._flush_path = flush_path
+        self._flush_secs = float(flush_secs) if flush_secs else None
+        self._last_flush = time.monotonic()
+        self._flush_gate = threading.Lock()
 
     # ------------------------------------------------------------- recording
     def _now_us(self) -> float:
@@ -159,6 +172,7 @@ class SpanTracer:
         with self._lock:
             self._events.append(event)
         counters.increment("trace_spans")
+        self._maybe_flush()
 
     def span(self, name: str, cat: str = "", **args) -> _Span:
         return _Span(self, name, cat, args)
@@ -195,6 +209,30 @@ class SpanTracer:
         with self._lock:
             self._events.append(event)
 
+    def _maybe_flush(self) -> None:
+        """Write the ring buffer to ``flush_path`` when the flush interval
+        has elapsed. Serialization happens OUTSIDE the event lock (events
+        keep appending while the snapshot serializes); a second thread
+        arriving mid-flush skips (non-blocking gate). Never raises — a
+        flush failure must not take down the run being traced."""
+        if self._flush_secs is None or self._flush_path is None:
+            return
+        now = time.monotonic()
+        if now - self._last_flush < self._flush_secs:
+            return
+        if not self._flush_gate.acquire(blocking=False):
+            return
+        try:
+            self._last_flush = now
+            tmp = self._flush_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.to_chrome_trace(), f)
+            os.replace(tmp, self._flush_path)  # readers never see a torn file
+        except Exception:
+            pass
+        finally:
+            self._flush_gate.release()
+
     # --------------------------------------------------------------- readout
     def events(self) -> List[dict]:
         with self._lock:
@@ -227,13 +265,23 @@ def tracing_enabled() -> bool:
 
 
 def start_tracing(
-    path: Optional[str] = None, *, capacity: int = DEFAULT_CAPACITY
+    path: Optional[str] = None,
+    *,
+    capacity: int = DEFAULT_CAPACITY,
+    flush_secs: Optional[float] = None,
 ) -> SpanTracer:
     """Install a fresh process tracer. ``path`` (optional) is where
-    :func:`stop_tracing` — or process exit — writes the trace."""
+    :func:`stop_tracing` — or process exit — writes the trace.
+    ``flush_secs`` (or ``EVOTORCH_TRACE_FLUSH_SECS`` in the environment;
+    default off) additionally rewrites ``path`` every that-many seconds,
+    so a killed run keeps a partial trace."""
     global _TRACER, _TRACE_PATH
     with _STATE_LOCK:
-        _TRACER = SpanTracer(capacity=capacity)
+        _TRACER = SpanTracer(
+            capacity=capacity,
+            flush_path=path if flush_secs else None,
+            flush_secs=flush_secs,
+        )
         _TRACE_PATH = path
         return _TRACER
 
@@ -277,7 +325,18 @@ def _write_at_exit() -> None:
             pass
 
 
+def _env_flush_secs() -> Optional[float]:
+    raw = os.environ.get("EVOTORCH_TRACE_FLUSH_SECS")
+    if not raw:
+        return None
+    try:
+        secs = float(raw)
+    except ValueError:
+        return None
+    return secs if secs > 0 else None
+
+
 _env_path = os.environ.get("EVOTORCH_TRACE")
 if _env_path:
-    start_tracing(_env_path)
+    start_tracing(_env_path, flush_secs=_env_flush_secs())
 atexit.register(_write_at_exit)

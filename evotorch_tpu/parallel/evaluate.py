@@ -324,6 +324,21 @@ def make_sharded_rollout_evaluator(
             )
         padded_n = -(-popsize // n_grid) * n_grid
         num_valid = popsize if padded_n != popsize else None
+        # per-group telemetry (ISSUE 15): the groups array is a build-time
+        # constant (one id per GENUINE solution); padding rows are
+        # first-row copies, so they charge row 0's group — and being
+        # permanently inactive, their only charge is capacity, exactly the
+        # v1 physical-lane accounting
+        groups = local_kwargs.pop("groups", None)
+        num_groups = int(local_kwargs.pop("num_groups", 1) or 1)
+        if groups is not None and num_groups > 1:
+            g = jnp.asarray(groups, dtype=jnp.int32)
+            if padded_n != popsize:
+                g = jnp.concatenate(
+                    [g, jnp.broadcast_to(g[:1], (padded_n - popsize,))]
+                )
+            local_kwargs["groups"] = g
+            local_kwargs["num_groups"] = num_groups
 
         def global_eval(values, key, stats):
             if padded_n != popsize:
@@ -416,6 +431,15 @@ def _shard_map_rollout_evaluator(
             )
         rollout_kwargs["refill_width"] = width // n_shards
 
+    # per-group telemetry rides in as an explicit 4th sharded input: each
+    # shard segment-sums over its local lanes and the additive (G, K) block
+    # psums mesh-global like every other telemetry slot
+    groups_global = rollout_kwargs.pop("groups", None)
+    num_groups = int(rollout_kwargs.pop("num_groups", 1) or 1)
+    collect_groups = groups_global is not None and num_groups > 1
+    if collect_groups:
+        groups_global = jnp.asarray(groups_global, dtype=jnp.int32)
+
     def build(lowrank: bool, popsize: int):
         # tuned-config cache: cache widths are GLOBAL, divided per shard with
         # the convenience-knob flooring (only an explicit width gets the
@@ -434,7 +458,7 @@ def _shard_map_rollout_evaluator(
                     1, int(local_kwargs["refill_width"]) // n_shards
                 )
 
-        def local(values_shard, key, stats):
+        def local(values_shard, key, stats, groups_shard=None):
             result = run_vectorized_rollout(
                 env,
                 policy,
@@ -444,6 +468,8 @@ def _shard_map_rollout_evaluator(
                 lane_ids=global_lane_ids(axis_name, _params_popsize(values_shard)),
                 stats_sync_axis=axis_name if stats_sync else None,
                 seed_stride=popsize,
+                groups=groups_shard,
+                num_groups=num_groups if groups_shard is not None else 1,
                 **local_kwargs,
             )
             if stats_sync:
@@ -471,11 +497,14 @@ def _shard_map_rollout_evaluator(
             )
 
         values_spec = _params_shard_spec(lowrank, axis_name)
+        in_specs = (values_spec, P(), P())
+        if collect_groups:
+            in_specs = in_specs + (P(axis_name),)
         fn = jax.jit(
             jax.shard_map(
                 local,
                 mesh=mesh,
-                in_specs=(values_spec, P(), P()),
+                in_specs=in_specs,
                 out_specs=(P(axis_name), P(), P(), P(), P(axis_name), P()),
                 check_vma=False,
             )
@@ -489,7 +518,14 @@ def _shard_map_rollout_evaluator(
         popsize = _params_popsize(values)
         fn, source = build(lowrank, popsize)
         evaluator.tuned_config_source = source
-        scores, merged, steps, episodes, per_shard, telemetry = fn(values, key, stats)
+        if collect_groups:
+            scores, merged, steps, episodes, per_shard, telemetry = fn(
+                values, key, stats, groups_global
+            )
+        else:
+            scores, merged, steps, episodes, per_shard, telemetry = fn(
+                values, key, stats
+            )
         result = RolloutResult(
             scores=scores,
             stats=merged,
@@ -543,6 +579,17 @@ def make_generation_step(
     n_grid = _mesh_grid_size(mesh)
     padded_n = -(-popsize // n_grid) * n_grid
     num_valid = popsize if padded_n != popsize else None
+    # per-group telemetry: pad the group-id array exactly like the
+    # population rows (first-element copies; see
+    # make_sharded_rollout_evaluator)
+    groups = rollout_kwargs.pop("groups", None)
+    num_groups = int(rollout_kwargs.pop("num_groups", 1) or 1)
+    if groups is not None and num_groups > 1:
+        g = jnp.asarray(groups, dtype=jnp.int32)
+        if padded_n != popsize:
+            g = jnp.concatenate([g, jnp.broadcast_to(g[:1], (padded_n - popsize,))])
+        rollout_kwargs["groups"] = g
+        rollout_kwargs["num_groups"] = num_groups
 
     def generation(state, key, stats):
         k_ask, k_eval = jax.random.split(key)

@@ -148,6 +148,21 @@ def main():
             outs[name] = float(jnp.mean(r.scores))
         return outs
 
+    # EVOTORCH_METRICS=path: stream every per-generation row (plus the
+    # lag-by-one decoded per-group telemetry and the counter registry)
+    # through the MetricsHub — JSONL with a schema-versioned manifest first
+    # line, or Prometheus text with a .prom suffix (docs/observability.md)
+    from evotorch_tpu.observability import MetricsHub
+
+    hub = MetricsHub.from_env(
+        manifest={
+            "source": "locomotion_curve",
+            "env": args.env,
+            "popsize": args.popsize,
+            "episode_length": args.episode_length,
+        }
+    )
+
     t_start = time.time()
     with open(out_path, "a") as f:
         for gen in range(1, args.generations + 1):
@@ -192,6 +207,8 @@ def main():
                 print(json.dumps(row), flush=True)
             f.write(json.dumps(row) + "\n")
             f.flush()
+            if hub is not None:
+                hub.emit(row, telemetry=problem.last_group_telemetry)
     print(
         json.dumps(
             {

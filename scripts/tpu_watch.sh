@@ -62,7 +62,12 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     bash scripts/tpu_window.sh >> "$LOG" 2>&1
     rc=$?
     echo "$(stamp) battery exited rc=$rc" >> "$LOG"
-    emit_event battery_exited true "$PROBE_PLATFORM" "\"rc\":$rc"
+    # the slo_check step's verdict (pass/fail; null until that step has run)
+    slo_json="null"
+    if [ -r "$OUT/slo_verdict.txt" ]; then
+      slo_json="\"$(head -n 1 "$OUT/slo_verdict.txt")\""
+    fi
+    emit_event battery_exited true "$PROBE_PLATFORM" "\"rc\":$rc,\"slo\":$slo_json"
     [ "$rc" -eq 0 ] && exit 0
     if [ "$rc" -eq 3 ]; then
       # tunnel-caused abort: not the battery's fault; probe at normal cadence

@@ -61,6 +61,13 @@ fi
 run bench_f32 1800 python bench.py
 run bench_bf16 1800 env BENCH_BF16=1 python bench.py
 
+# 1b. SLO verdict over the flagship f32 line (steady_compiles == 0 +
+#     occupancy floor on every contract; docs/observability.md "Per-group
+#     telemetry & SLOs"). Writes the one-word pass/fail verdict file that
+#     tpu_watch.sh attaches to its battery_exited JSONL event.
+run slo_check 300 python -m evotorch_tpu.observability.slo \
+  --check-bench "$OUT/bench_f32.log" --verdict-out "$OUT/slo_verdict.txt"
+
 # 2. the MXU claim: wide policy dense vs low-rank (budget contract isolates
 #    the policy cost; episodes_compact shows the combined effect)
 run wide_dense 1800 env BENCH_HIDDEN=256,256 BENCH_BF16=1 python bench.py

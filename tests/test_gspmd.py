@@ -30,7 +30,7 @@ from evotorch_tpu.parallel import (
     mesh_label,
     parse_mesh_shape,
 )
-from evotorch_tpu.observability import EvalTelemetry
+from evotorch_tpu.observability import EvalTelemetry, GroupTelemetry
 
 
 @pytest.fixture(scope="module")
@@ -181,6 +181,122 @@ def test_gspmd_padding_masks_counters_and_telemetry(cartpole_setup):
     telem = EvalTelemetry.from_array(result.telemetry)
     assert telem.env_steps == 13 * 4  # genuine work only
     assert telem.lane_width == 16  # physical (padded) lanes
+
+
+# ---------------------------------------------------------------------------
+# per-group telemetry: the (G, 14) matrix is mesh-invariant
+# ---------------------------------------------------------------------------
+
+
+def test_gspmd_per_group_matrix_bit_identical_across_meshes(cartpole_setup):
+    # the per-group matrix is part of the GLOBAL program's output, so it
+    # must be BIT-identical unsharded vs 1-D vs 2-D pop x model — including
+    # the queue-wait histogram block (refill is the contract that fills it)
+    env, policy, stats = cartpole_setup
+    values = _population(policy, 16)
+    key = jax.random.key(3)
+    groups = np.arange(16, dtype=np.int32) % 2
+    kwargs = dict(
+        num_episodes=1, episode_length=8, eval_mode="episodes_refill",
+        refill_width=8, refill_period=1, groups=groups, num_groups=2,
+    )
+    ref = run_vectorized_rollout(env, policy, values, key, stats, **kwargs)
+    tref = GroupTelemetry.from_array(ref.telemetry)
+    assert tref.data.shape == (2, 14)
+    for mesh_shape in ({"pop": 8}, {"pop": 4, "model": 2}):
+        ev = make_sharded_rollout_evaluator(
+            env, policy, mesh=make_mesh(mesh_shape), **kwargs
+        )
+        result, _ = ev(values, key, stats)
+        np.testing.assert_array_equal(
+            np.asarray(result.scores), np.asarray(ref.scores)
+        )
+        t = GroupTelemetry.from_array(result.telemetry)
+        np.testing.assert_array_equal(t.data, tref.data)
+
+
+def test_gspmd_per_group_padding_masks_popsize_1000(cartpole_setup):
+    # 1000 lanes on the 3-device mesh (1000 % 3 != 0 -> padded to 1002
+    # physical lanes): the pad lanes never activate, so the per-group
+    # env-step/episode columns match unsharded exactly; capacity/lane_width
+    # count physical lanes (the pads charge group 0, the row they were
+    # copied from)
+    env, policy, stats = cartpole_setup
+    values = _population(policy, 1000, seed=5)
+    key = jax.random.key(7)
+    groups = np.arange(1000, dtype=np.int32) % 2
+    kwargs = dict(
+        num_episodes=1, episode_length=2, eval_mode="episodes",
+        groups=groups, num_groups=2,
+    )
+    ref = run_vectorized_rollout(env, policy, values, key, stats, **kwargs)
+    tref = GroupTelemetry.from_array(ref.telemetry)
+    ev = make_sharded_rollout_evaluator(
+        env, policy, mesh=make_mesh({"pop": 3}), **kwargs
+    )
+    result, _ = ev(values, key, stats)
+    np.testing.assert_array_equal(np.asarray(result.scores), np.asarray(ref.scores))
+    t = GroupTelemetry.from_array(result.telemetry)
+    np.testing.assert_array_equal(t.data[:, 0], tref.data[:, 0])  # env_steps
+    np.testing.assert_array_equal(t.data[:, 1], tref.data[:, 1])  # episodes
+    assert int(t.data[:, 3].sum()) == 1002  # physical (padded) lanes
+
+
+def test_shard_map_per_group_psum_additivity(cartpole_setup):
+    # legacy explicit path: each shard segment-sums its own partial matrix,
+    # psum makes it mesh-global — the G=2 matrix must column-sum to the same
+    # path's G=1 globals and the histogram must count every refill
+    env, policy, stats = cartpole_setup
+    values = _population(policy, 16)
+    key = jax.random.key(3)
+    groups = np.arange(16, dtype=np.int32) % 2
+    kwargs = dict(
+        num_episodes=1, episode_length=8, eval_mode="episodes_refill",
+        refill_width=8, refill_period=1, use_shard_map=True,
+    )
+    ev1 = make_sharded_rollout_evaluator(
+        env, policy, mesh=make_mesh({"pop": 8}), **kwargs
+    )
+    res1, _ = ev1(values, key, stats)
+    ev2 = make_sharded_rollout_evaluator(
+        env, policy, mesh=make_mesh({"pop": 8}), groups=groups, num_groups=2,
+        **kwargs,
+    )
+    res2, _ = ev2(values, key, stats)
+    np.testing.assert_array_equal(np.asarray(res1.scores), np.asarray(res2.scores))
+    t1 = GroupTelemetry.from_array(res1.telemetry)
+    t2 = GroupTelemetry.from_array(res2.telemetry)
+    assert t2.data.shape == (2, 14)
+    s1, s2 = t1.total(), t2.total()
+    for field in (
+        "env_steps", "episodes", "capacity", "lane_width",
+        "refill_events", "queue_wait",
+    ):
+        assert getattr(s1, field) == getattr(s2, field), field
+    assert int(t2.hist.sum()) == s2.refill_events
+
+
+def test_compacting_sharded_per_group_counts(cartpole_setup):
+    env, policy, stats = cartpole_setup
+    values = _population(policy, 16)
+    key = jax.random.key(3)
+    groups = np.arange(16, dtype=np.int32) % 2
+    ref = run_vectorized_rollout_compacting_sharded(
+        env, policy, values, key, stats, mesh=make_mesh({"pop": 8}),
+        num_episodes=1, episode_length=8, chunk_size=4,
+    )
+    result = run_vectorized_rollout_compacting_sharded(
+        env, policy, values, key, stats, mesh=make_mesh({"pop": 8}),
+        num_episodes=1, episode_length=8, chunk_size=4,
+        groups=groups, num_groups=2,
+    )
+    np.testing.assert_array_equal(np.asarray(result.scores), np.asarray(ref.scores))
+    t = GroupTelemetry.from_array(result.telemetry)
+    assert t.data.shape == (2, 14)
+    tref = GroupTelemetry.from_array(ref.telemetry)
+    s, sref = t.total(), tref.total()
+    for field in ("env_steps", "episodes", "capacity", "lane_width"):
+        assert getattr(s, field) == getattr(sref, field), field
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,13 @@ from evotorch_tpu.neuroevolution.net import (
 from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
 from evotorch_tpu.observability import (
     EvalTelemetry,
+    GROUP_TELEMETRY_WIDTH,
+    GroupTelemetry,
+    MetricsHub,
+    QUEUE_WAIT_BUCKETS,
+    Rule,
+    SLOWatchdog,
+    TELEMETRY_SCHEMA_VERSION,
     TELEMETRY_WIDTH,
     counters,
     pack_eval_telemetry,
@@ -183,6 +190,267 @@ def test_refill_queue_wait_counts_gated_idle_lanes():
 
 
 # ---------------------------------------------------------------------------
+# per-group telemetry (the v2 (G, 14) wire)
+# ---------------------------------------------------------------------------
+
+
+def _group_matrix():
+    """A synthetic two-group matrix: g0 healthy, g1 starved."""
+    data = np.zeros((2, GROUP_TELEMETRY_WIDTH), dtype=np.int64)
+    data[0, :TELEMETRY_WIDTH] = [90, 10, 100, 4, 10, 5]
+    data[1, :TELEMETRY_WIDTH] = [2, 0, 100, 4, 6, 300]
+    data[0, TELEMETRY_WIDTH:] = [8, 1, 1, 0, 0, 0, 0, 0]
+    data[1, TELEMETRY_WIDTH:] = [0, 0, 0, 0, 0, 1, 0, 5]
+    return data
+
+
+def test_group_telemetry_decode_total_and_quantiles():
+    assert TELEMETRY_SCHEMA_VERSION == 2
+    gt = GroupTelemetry.from_array(_group_matrix())
+    assert gt.num_groups == 2
+    assert gt.hist.shape == (2, QUEUE_WAIT_BUCKETS)
+    # total() collapses to the v1 global figures
+    total = gt.total()
+    assert total.env_steps == 92 and total.capacity == 200
+    assert gt.group(0).occupancy == 0.9
+    # Prometheus-style upper-edge quantiles off the bucketed histogram
+    assert gt.queue_wait_quantile(0.5, group=0) == 0.0  # bucket 0 = waits of 0
+    assert gt.queue_wait_quantile(0.99) >= gt.queue_wait_quantile(0.5)
+    assert gt.queue_wait_quantile(0.99, group=1) == 64.0  # overflow bucket
+    # starvation = the overflow bucket's share of refills
+    assert gt.starvation_share(group=0) == 0.0
+    assert gt.starvation_share(group=1) == pytest.approx(5 / 6)
+    # addition pads the shorter matrix (sub-batch additivity)
+    summed = gt + GroupTelemetry.from_array(_group_matrix()[:1])
+    assert summed.total().env_steps == 92 + 90
+    # the v1 decoder reads the same wire (column sums)
+    assert EvalTelemetry.from_array(_group_matrix()).env_steps == 92
+
+
+def test_v1_wire_golden_decode_still_works():
+    # the frozen v1 contract: a (6,) vector decodes field-for-field, and
+    # GroupTelemetry lifts it into a single-group matrix with empty buckets
+    golden = np.array([160, 8, 160, 8, 4, 12], dtype=np.int32)
+    t = EvalTelemetry.from_array(golden)
+    assert (t.env_steps, t.episodes, t.capacity, t.lane_width) == (160, 8, 160, 8)
+    assert (t.refill_events, t.queue_wait) == (4, 12)
+    gt = GroupTelemetry.from_array(golden)
+    assert gt.num_groups == 1
+    assert gt.hist.sum() == 0
+    assert gt.total() == t
+
+
+@pytest.mark.parametrize(
+    "mode,kw",
+    [
+        ("budget", {}),
+        ("episodes", {}),
+        ("episodes_refill", {"refill_width": 4}),
+    ],
+)
+def test_group_counters_sum_to_global(mode, kw):
+    # the acceptance contract: a two-group split of the same population
+    # yields identical scores and per-group counters that column-sum
+    # EXACTLY to the G=1 globals, on every contract
+    env, policy = _env_policy()
+    stats = RunningNorm(env.observation_size).stats
+    params = jax.random.normal(jax.random.key(0), (POPSIZE, policy.parameter_count))
+    key = jax.random.key(1)
+    groups = np.arange(POPSIZE, dtype=np.int32) % 2
+    common = dict(num_episodes=1, episode_length=EPISODE_LENGTH)
+    base = run_vectorized_rollout(
+        env, policy, params, key, stats, eval_mode=mode, **common, **kw
+    )
+    split = run_vectorized_rollout(
+        env, policy, params, key, stats, eval_mode=mode,
+        groups=groups, num_groups=2, **common, **kw,
+    )
+    assert jnp.array_equal(base.scores, split.scores)
+    t1 = GroupTelemetry.from_array(base.telemetry)
+    t2 = GroupTelemetry.from_array(split.telemetry)
+    assert t1.num_groups == 1 and t2.num_groups == 2
+    assert t1.total() == t2.total()
+
+
+def test_group_counters_sum_to_global_compacting():
+    env, policy = _env_policy()
+    stats = RunningNorm(env.observation_size).stats
+    params = jax.random.normal(jax.random.key(0), (POPSIZE, policy.parameter_count))
+    key = jax.random.key(1)
+    groups = np.arange(POPSIZE, dtype=np.int32) % 2
+    common = dict(num_episodes=1, episode_length=EPISODE_LENGTH)
+    base = run_vectorized_rollout_compacting(
+        env, policy, params, key, stats, allowed_widths=(4,), **common
+    )
+    split = run_vectorized_rollout_compacting(
+        env, policy, params, key, stats, allowed_widths=(4,),
+        groups=groups, num_groups=2, **common,
+    )
+    assert jnp.array_equal(base.scores, split.scores)
+    assert (
+        GroupTelemetry.from_array(base.telemetry).total()
+        == GroupTelemetry.from_array(split.telemetry).total()
+    )
+
+
+def test_refill_group_histogram_counts_every_refill():
+    env, policy = _env_policy()
+    stats = RunningNorm(env.observation_size).stats
+    params = jax.random.normal(jax.random.key(0), (POPSIZE, policy.parameter_count))
+    groups = np.arange(POPSIZE, dtype=np.int32) % 2
+    # refill_period > 1 makes lanes idle before refilling, so waits land in
+    # nonzero buckets
+    r = run_vectorized_rollout(
+        env, policy, params, jax.random.key(1), stats, num_episodes=1,
+        episode_length=EPISODE_LENGTH, eval_mode="episodes_refill",
+        refill_width=2, refill_period=7, groups=groups, num_groups=2,
+    )
+    gt = GroupTelemetry.from_array(r.telemetry)
+    # every refill lands in exactly one bucket of its group's histogram
+    assert int(gt.hist.sum()) == gt.total().refill_events == POPSIZE - 2
+    assert gt.queue_wait_quantile(0.99) >= gt.queue_wait_quantile(0.5)
+
+
+def test_vecne_solution_groups_status_and_slo():
+    from evotorch_tpu.algorithms import PGPE
+    from evotorch_tpu.neuroevolution import VecNE
+
+    problem = VecNE(
+        CartPole(),
+        "Linear(obs_length, act_length)",
+        episode_length=EPISODE_LENGTH,
+        eval_mode="episodes_refill",
+        refill_config={"width": 4},
+        solution_groups=np.arange(POPSIZE, dtype=np.int32) % 2,
+        slo=[
+            {"kind": "occupancy_floor", "threshold": 0.01},
+            {"kind": "min_progress", "threshold": 1},
+        ],
+        seed=0,
+    )
+    searcher = PGPE(
+        problem,
+        popsize=POPSIZE,
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        stdev_init=0.1,
+    )
+    searcher.step()
+    searcher.step()
+    status = dict(searcher.status.items())
+    # per-group status keys appear at G > 1 (lag-by-one, live by step 2)
+    assert 0.0 < status["eval_g0_occupancy"] <= 1.0
+    assert 0.0 < status["eval_g1_occupancy"] <= 1.0
+    assert (
+        status["eval_g0_env_steps"] + status["eval_g1_env_steps"]
+        == problem.last_group_telemetry.total().env_steps
+    )
+    # the watchdog ran and passed (both groups make progress)
+    assert status["slo_ok"] is True and status["slo_violations"] == 0
+    # mismatched mapping fails loudly
+    with pytest.raises(ValueError, match="solution_groups maps"):
+        problem._check_solution_groups(POPSIZE + 1)
+
+
+# ---------------------------------------------------------------------------
+# SLO watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_slo_watchdog_flags_starved_group():
+    gt = GroupTelemetry.from_array(_group_matrix())
+    watchdog = SLOWatchdog([
+        Rule("occupancy_floor", threshold=0.5),
+        Rule("starvation_ceiling", threshold=0.25, group=1),
+        Rule("min_progress", threshold=5),
+        Rule("no_steady_compiles"),
+    ])
+    report = watchdog.check(gt, status={"steady_compiles": 0})
+    assert not report.ok
+    detail = "; ".join(report.violations)
+    # the starved group is named in every violated rule
+    assert "g1" in detail and "starvation" in detail and "env_steps" in detail
+    status = report.as_status()
+    assert status["slo_ok"] is False and status["slo_violations"] == 3
+    # the healthy group alone passes the same rules
+    healthy = SLOWatchdog([
+        Rule("occupancy_floor", threshold=0.5, group=0),
+        Rule("starvation_ceiling", threshold=0.25, group=0),
+    ]).check(gt)
+    assert healthy.ok and healthy.as_status()["slo_ok"] is True
+    # a steady-state retrace violates regardless of telemetry
+    retrace = SLOWatchdog([Rule("no_steady_compiles")]).check(
+        None, status={"steady_compiles": 2}
+    )
+    assert not retrace.ok
+    with pytest.raises(ValueError, match="unknown SLO rule kind"):
+        Rule("bogus")
+
+
+def test_slo_bench_line_verdict(tmp_path):
+    from evotorch_tpu.observability.slo import _main, check_bench_line
+
+    good = {"occupancy": 0.62, "steady_compiles": 0,
+            "modes": {"budget": {"occupancy": 0.9}}}
+    assert check_bench_line(good).ok
+    bad = {"occupancy": 0.02, "steady_compiles": 1}
+    report = check_bench_line(bad)
+    assert not report.ok and len(report.violations) == 2
+    # the CLI form tpu_window.sh's slo_check step runs: last JSON line of
+    # the log, one-word verdict file, exit status as the step verdict
+    log = tmp_path / "bench.log"
+    log.write_text("noise\n" + json.dumps(good) + "\n" + json.dumps(bad) + "\n")
+    verdict = tmp_path / "slo_verdict.txt"
+    rc = _main(["--check-bench", str(log), "--verdict-out", str(verdict)])
+    assert rc == 1 and verdict.read_text().strip() == "fail"
+    log.write_text(json.dumps(good) + "\n")
+    rc = _main(["--check-bench", str(log), "--verdict-out", str(verdict)])
+    assert rc == 0 and verdict.read_text().strip() == "pass"
+
+
+# ---------------------------------------------------------------------------
+# MetricsHub
+# ---------------------------------------------------------------------------
+
+
+def test_metricshub_jsonl_stream(tmp_path, monkeypatch):
+    gt = GroupTelemetry.from_array(_group_matrix())
+    path = tmp_path / "metrics.jsonl"
+    hub = MetricsHub(str(path), manifest={"mesh": "none", "env": "cartpole"})
+    hub.emit({"gen": 1, "mean_eval": 3.5}, telemetry=gt)
+    hub.emit({"gen": 2}, telemetry=gt.total())
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    manifest = lines[0]["manifest"]
+    assert manifest["schema_version"] == TELEMETRY_SCHEMA_VERSION
+    assert manifest["mesh"] == "none" and "created_unix" in manifest
+    row = lines[1]
+    assert row["row"] == 0 and row["gen"] == 1
+    assert row["eval_env_steps"] == 92 and len(row["groups"]) == 2
+    assert "counters" in row and "queue_wait_p99" in row
+    # an EvalTelemetry lifts to G=1: no per-group block
+    assert lines[2]["row"] == 1 and "groups" not in lines[2]
+    # the env knob: unset -> no hub; set -> a hub at that path
+    monkeypatch.delenv("EVOTORCH_METRICS", raising=False)
+    assert MetricsHub.from_env() is None
+    monkeypatch.setenv("EVOTORCH_METRICS", str(tmp_path / "envhub.jsonl"))
+    assert MetricsHub.from_env().path.endswith("envhub.jsonl")
+
+
+def test_metricshub_prometheus_rewrite(tmp_path):
+    gt = GroupTelemetry.from_array(_group_matrix())
+    path = tmp_path / "metrics.prom"
+    hub = MetricsHub(str(path))
+    hub.emit({"gen": 7, "mean_eval": 1.25}, telemetry=gt)
+    text = path.read_text()
+    assert 'evotorch_eval_occupancy{group="1"}' in text
+    assert "evotorch_gen 7" in text
+    # full rewrite, not append: a second emit leaves ONE copy of each series
+    hub.emit({"gen": 8}, telemetry=gt)
+    text = path.read_text()
+    assert text.count("evotorch_gen ") == 1 and "evotorch_gen 8" in text
+
+
+# ---------------------------------------------------------------------------
 # span tracer
 # ---------------------------------------------------------------------------
 
@@ -263,6 +531,35 @@ def test_manual_complete_spans(fresh_tracer):
 # ---------------------------------------------------------------------------
 # registry + status surfacing
 # ---------------------------------------------------------------------------
+
+
+def test_tracer_periodic_flush_keeps_partial_trace(tmp_path):
+    # EVOTORCH_TRACE_FLUSH_SECS: a killed run keeps the last flushed window
+    # instead of losing the whole trace at the missed atexit hook
+    path = str(tmp_path / "trace.json")
+    tracer.start_tracing(path, flush_secs=0.01)
+    try:
+        import time as _time
+
+        with tracer.span("first"):
+            pass
+        _time.sleep(0.02)
+        with tracer.span("second"):  # completion past the interval -> flush
+            pass
+        data = json.loads(open(path).read())
+        names = {e["name"] for e in data["traceEvents"] if e.get("ph") == "X"}
+        assert {"first", "second"} <= names
+    finally:
+        tracer.stop_tracing(write=False)
+    # flush stays off without the knob: nothing written before stop
+    path2 = str(tmp_path / "trace2.json")
+    tracer.start_tracing(path2)
+    with tracer.span("quiet"):
+        pass
+    import os as _os
+
+    assert not _os.path.exists(path2)
+    assert tracer.stop_tracing() == path2
 
 
 def test_registry_increment_snapshot_delta_threadsafe():
