@@ -39,8 +39,11 @@ contract and hoisted top-level for the primary one: ``compile_seconds``
 (AOT compile wall-time of the contract's program), ``flops_per_step``
 (cost-model FLOPs per counted env-step), ``peak_hbm_bytes`` (analyzed peak
 footprint — donation-aware, a dropped ``donate_argnums`` inflates it) and
-``model_efficiency`` (achieved FLOP rate vs the nominal per-backend peak;
-``EVOTORCH_PEAK_FLOPS`` overrides). ``BENCH_LEDGER=0`` skips the capture
+``model_efficiency`` (MFU-style: achieved MODEL FLOP rate —
+2 x param_count useful FLOPs per counted env-step — vs the nominal
+per-backend peak; ``EVOTORCH_PEAK_FLOPS`` overrides; see
+bench_common.ledger_columns for why the cost-model FLOPs are NOT the
+numerator). ``BENCH_LEDGER=0`` skips the capture
 (one extra untimed trace+compile per contract) and keeps the line
 byte-compatible with pre-ledger rounds.
 
@@ -53,6 +56,17 @@ engine defaults. The line carries ``tuned_config_source``
 refill width/period inside ``modes``). ``BENCH_TUNED=0`` disables both
 the consult and the new keys — the line is then byte-compatible with
 r9/r10 output.
+
+``BENCH_TRUNK_DELTA=1`` evaluates the shared-trunk + per-lane
+low-rank-delta policy form (docs/policies.md) through all four contracts
+and ALSO times an interleaved dense-vs-trunk-delta A/B of the primary
+contract (``BENCH_TRUNK_AB_REPEATS`` samples each, default 3, medians):
+``trunk_delta_speedup`` / ``dense_value`` land on the line together with
+the effective ``trunk_rank`` / ``trunk_block`` (explicit
+``BENCH_TRUNK_RANK`` / ``BENCH_TRUNK_BLOCK`` override, else the tuned
+``policy`` group's winner for this shape, else rank 4 unblocked). With the
+ledger on, every line also self-describes with ``hidden`` /
+``param_count`` / ``policy_form`` (dense / lowrank / trunk_delta).
 
 ``BENCH_COMPILE_CACHE=1`` enables the persistent XLA compilation cache
 (observability/compilecache.py; dir override ``EVOTORCH_COMPILE_CACHE_DIR``)
@@ -72,18 +86,21 @@ columns on the same JSON line (knobs: ``BENCH_MJ_ENV``, ``BENCH_MJ_POPSIZE``,
 
 import json
 import os
+import statistics
 import sys
 import time
 from functools import partial
 
 from bench_common import (
     bench_config,
+    bench_hidden,
     build_policy,
     fresh_pgpe_state,
     ledger_columns,
     measure_mujoco,
     setup_backend,
     tuned_compact,
+    tuned_policy,
     tuned_refill,
 )
 
@@ -98,8 +115,10 @@ def main():
     from evotorch_tpu.algorithms.functional import (
         pgpe_ask,
         pgpe_ask_lowrank,
+        pgpe_ask_trunk_delta,
         pgpe_tell,
         pgpe_tell_lowrank,
+        pgpe_tell_trunk_delta,
     )
     from evotorch_tpu.analysis import track_compiles
     from evotorch_tpu.envs import make_env
@@ -127,13 +146,26 @@ def main():
     compute_dtype = cfg["compute_dtype"]
     eval_mode = cfg["eval_mode"]
     lowrank = cfg["lowrank"]
-    if lowrank:
+    trunk_delta = cfg["trunk_delta"]
+    if trunk_delta and lowrank:
+        raise SystemExit("BENCH_TRUNK_DELTA=1 and BENCH_LOWRANK are exclusive")
+    env = make_env(cfg["env_name"], **cfg["env_kwargs"])
+    policy = build_policy(env)
+    trunk_cfg, trunk_src = {}, None
+    if trunk_delta:
+        # rank / lane blocking resolve like the schedules: explicit
+        # BENCH_TRUNK_* knobs override, else the tuned-config cache's
+        # `policy` group (autotune --group policy), else rank 4 unblocked
+        trunk_cfg, trunk_src = tuned_policy(cfg, params=policy.parameter_count)
+        ask = partial(
+            pgpe_ask_trunk_delta, rank=trunk_cfg["rank"], policy=policy
+        )
+        tell = pgpe_tell_trunk_delta
+    elif lowrank:
         ask = partial(pgpe_ask_lowrank, rank=lowrank)
         tell = pgpe_tell_lowrank
     else:
         ask, tell = pgpe_ask, pgpe_tell
-    env = make_env(cfg["env_name"], **cfg["env_kwargs"])
-    policy = build_policy(env)
     print(
         f"devices={jax.devices()} popsize={popsize} params={policy.parameter_count} "
         f"episode_length={episode_length} compute_dtype={compute_dtype or 'float32'}",
@@ -197,7 +229,12 @@ def main():
             state, steps, scores, telemetry = gen(state, sub, prewarm=True)
             jax.block_until_ready(scores)
         else:
-            extra = refill_cfg if mode == "episodes_refill" else {}
+            extra = dict(refill_cfg) if mode == "episodes_refill" else {}
+            if trunk_delta:
+                # static lane-block size of the trunk-delta forward (0 = one
+                # block); monolithic modes only — the compacting runner's
+                # width descent already rules out a fixed lane blocking
+                extra["trunk_block"] = trunk_cfg["trunk_block"]
 
             def generation(state, key):
                 k1, k2 = jax.random.split(key)
@@ -253,7 +290,14 @@ def main():
                 "popsize": popsize,
                 "episode_length": episode_length,
             }
-            if mode == "episodes_compact":
+            if trunk_delta:
+                shape["rank"] = trunk_cfg["rank"]
+            if mode == "episodes_compact" and trunk_delta:
+                # capture_compact_chunk builds a DENSE params batch — its
+                # record would mislabel the trunk-delta chunk program's
+                # FLOPs/memory, so the compact columns stay null here
+                record = None
+            elif mode == "episodes_compact":
                 record = capture_compact_chunk(
                     program_ledger, env, policy, popsize, episode_length,
                     chunk_size=ckw["chunk_size"],
@@ -325,6 +369,7 @@ def main():
                         record,
                         steps_per_sec=sps,
                         steps_per_generation=steps_per_gen,
+                        param_count=policy.parameter_count,
                     )
                 )
             else:
@@ -333,8 +378,75 @@ def main():
                         record,
                         steps_per_sec=sps,
                         steps_per_generation=(sps / gps if gps else None),
+                        param_count=policy.parameter_count,
                     )
                 )
+
+    trunk_ab = {}
+    if trunk_delta:
+        # BENCH_TRUNK_DELTA=1: the headline policy-form A/B — dense per-lane
+        # vs shared-trunk + delta on the primary contract (budget when the
+        # primary is the host-orchestrated compact runner), INTERLEAVED
+        # median-of-N samples (this box times ±20% run-to-run;
+        # BENCH_TRUNK_AB_REPEATS, default 3). Both programs compile once,
+        # outside every timed loop, and run under the retrace sentinel.
+        ab_mode = eval_mode if eval_mode != "episodes_compact" else "budget"
+        ab_extra = dict(refill_cfg) if ab_mode == "episodes_refill" else {}
+        # the dense leg ignores trunk_block (net/vecrl.py _forward_ctx)
+        ab_extra["trunk_block"] = trunk_cfg["trunk_block"]
+
+        def build_ab_gen(ask_fn, tell_fn):
+            def generation(state, key):
+                k1, k2 = jax.random.split(key)
+                values = ask_fn(k1, state)
+                result = run_vectorized_rollout(
+                    env, policy, values, k2, stats, eval_mode=ab_mode,
+                    **ab_extra, **rollout_kwargs,
+                )
+                state = tell_fn(state, values, result.scores)
+                return state, result.total_steps, result.scores
+
+            return jax.jit(generation, donate_argnums=(0,))
+
+        ab_runs = {}
+        for form, ask_fn, tell_fn in (
+            ("dense", lambda k, s: pgpe_ask(k, s, popsize=popsize), pgpe_tell),
+            ("trunk_delta", lambda k, s: ask(k, s, popsize=popsize), tell),
+        ):
+            gen_ab = build_ab_gen(ask_fn, tell_fn)
+            st = fresh_pgpe_state(policy.parameter_count)
+            key, sub = jax.random.split(key)
+            st, _, scores = gen_ab(st, sub)
+            jax.block_until_ready(scores)
+            ab_runs[form] = {"gen": gen_ab, "state": st, "samples": []}
+        ab_repeats = int(os.environ.get("BENCH_TRUNK_AB_REPEATS", "3"))
+        for _ in range(ab_repeats):
+            for form, run in ab_runs.items():
+                gen_ab, st = run["gen"], run["state"]
+                with track_compiles() as compile_log:
+                    t0 = time.perf_counter()
+                    sample_steps = 0
+                    for _ in range(generations):
+                        key, sub = jax.random.split(key)
+                        st, steps, scores = gen_ab(st, sub)
+                        jax.block_until_ready(scores)
+                        sample_steps += int(steps)
+                    elapsed = time.perf_counter() - t0
+                steady_compiles += compile_log.count
+                run["state"] = st
+                run["samples"].append(sample_steps / elapsed)
+        med = {f: statistics.median(r["samples"]) for f, r in ab_runs.items()}
+        print(
+            f"[trunk_ab/{ab_mode}] {ab_repeats} interleaved samples: dense "
+            f"{med['dense']:.0f} vs trunk_delta {med['trunk_delta']:.0f} "
+            f"steps/s ({med['trunk_delta'] / med['dense']:.2f}x)",
+            file=sys.stderr,
+        )
+        trunk_ab = {
+            "dense_value": round(med["dense"], 1),
+            "trunk_delta_speedup": round(med["trunk_delta"] / med["dense"], 3),
+            "trunk_ab_mode": ab_mode,
+        }
 
     primary = modes[eval_mode]
     # the episodes-contract headline is the best runner of that contract
@@ -403,6 +515,15 @@ def main():
         )
         modes["episodes_refill"]["refill_period"] = refill_cfg.get("refill_period")
         modes["episodes_compact"]["tuned_config_source"] = compact_src
+    if trunk_delta:
+        # BENCH_TRUNK_DELTA=1 only: the policy-form A/B columns and the
+        # effective rank / lane blocking (absent by default, so the
+        # default line stays byte-compatible)
+        line.update(trunk_ab)
+        line["trunk_rank"] = trunk_cfg["rank"]
+        line["trunk_block"] = trunk_cfg["trunk_block"]
+        if cfg["tuned"]:
+            line["trunk_config_source"] = trunk_src
     if cfg["ledger"]:
         # the primary contract's program-ledger figures, hoisted next to
         # `value` (per-contract copies live inside `modes`); absent entirely
@@ -414,6 +535,13 @@ def main():
             "model_efficiency",
         ):
             line[column] = primary.get(column)
+        # self-description for bench_curves/ policy-shape sweeps (rides the
+        # ledger gate so BENCH_LEDGER=0 lines stay byte-compatible)
+        line["hidden"] = bench_hidden()
+        line["param_count"] = policy.parameter_count
+        line["policy_form"] = (
+            "trunk_delta" if trunk_delta else "lowrank" if lowrank else "dense"
+        )
     if cfg["compile_cache"]:
         # hit/miss counters from the persistent compile cache plus the
         # derived provenance: "warm" = every program this process compiled

@@ -82,6 +82,24 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
         # BENCH_LOWRANK=k: evaluate a low-rank-structured population of rank k
         # (the MXU path for wide policies, net/lowrank.py); 0 = dense
         "lowrank": int(os.environ.get("BENCH_LOWRANK", "0")),
+        # BENCH_TRUNK_DELTA=1: evaluate a shared-trunk + per-lane
+        # low-rank-delta population (docs/policies.md) — the per-lane forward
+        # becomes ONE shared-weight GEMM over the whole popsize x obs batch
+        # plus a cheap rank-k correction — and run the in-process interleaved
+        # dense A/B (`trunk_delta_speedup` on the line). Rank / lane blocking
+        # resolve like the refill schedule: explicit knobs override, else the
+        # tuned-config cache's `policy` group, else rank 4 / no blocking.
+        "trunk_delta": os.environ.get("BENCH_TRUNK_DELTA", "0") == "1",
+        "trunk_rank": (
+            int(os.environ["BENCH_TRUNK_RANK"])
+            if "BENCH_TRUNK_RANK" in os.environ
+            else None
+        ),
+        "trunk_block": (
+            int(os.environ["BENCH_TRUNK_BLOCK"])
+            if "BENCH_TRUNK_BLOCK" in os.environ
+            else None
+        ),
         "env_name": os.environ.get("BENCH_ENV", "humanoid"),
         "env_kwargs": json.loads(os.environ.get("BENCH_ENV_ARGS", "{}")),
         # lane-compaction tuning (episodes_compact only): chunk size between
@@ -230,14 +248,41 @@ def refill_kwargs(cfg: dict, *, n_shards: int = 1, params=None, mesh_label: str 
     return tuned_refill(cfg, n_shards=n_shards, params=params, mesh_label=mesh_label)[0]
 
 
+def tuned_policy(cfg: dict, *, params=None, mesh_label: str = "none"):
+    """Trunk-delta policy-form knobs (``rank``, ``trunk_block``) +
+    ``tuned_config_source`` provenance — same precedence and cache key as
+    the schedule knobs, under the autotuner's ``policy`` group
+    (observability/autotune.py ``PolicyHarness``). Fallback: rank 4 (the
+    harness's cheapest candidate) and no lane blocking."""
+    from evotorch_tpu.observability.timings import resolve_knobs
+
+    explicit = {"rank": cfg["trunk_rank"], "trunk_block": cfg["trunk_block"]}
+    config, source = resolve_knobs(
+        explicit,
+        "policy",
+        _tuned_shape(cfg, params, mesh_label),
+        use_cache=_use_tuned_cache(cfg, params),
+    )
+    return {
+        "rank": int(config.get("rank") or 4),
+        "trunk_block": int(config.get("trunk_block") or 0),
+    }, source
+
+
+def bench_hidden() -> list:
+    """The BENCH_HIDDEN layer widths as a list of ints (default ``[64, 64]``)
+    — also the ``hidden`` column bench.py stamps on ledger-carrying lines so
+    bench_curves/ files are self-describing across policy-shape sweeps."""
+    return [int(h) for h in os.environ.get("BENCH_HIDDEN", "64,64").split(",") if h]
+
+
 def _bench_mlp(obs_dim: int, act_dim: int):
     """The BENCH_HIDDEN-sized MLP, shared by every bench policy builder so
     the bespoke-sim contracts, the real-MuJoCo A/B and the program ledger's
     gate programs cannot silently bench different architectures."""
     from evotorch_tpu.neuroevolution.net import tanh_mlp
 
-    hidden = [int(h) for h in os.environ.get("BENCH_HIDDEN", "64,64").split(",") if h]
-    return tanh_mlp(obs_dim, act_dim, hidden)
+    return tanh_mlp(obs_dim, act_dim, bench_hidden())
 
 
 def build_policy(env):
@@ -374,16 +419,24 @@ def measure_mujoco(cfg: dict) -> dict:
     }
 
 
-def ledger_columns(record, *, steps_per_sec, steps_per_generation):
+def ledger_columns(record, *, steps_per_sec, steps_per_generation, param_count=None):
     """The per-contract program-ledger columns bench.py/bench_multichip.py
     append when BENCH_LEDGER is on. Nullable by design: a backend whose
     cost/memory analysis is unavailable emits nulls, never crashes
     (observability.programs guarded accessors).
 
-    ``flops_per_step`` is the cost model's FLOPs per counted env-step;
-    ``model_efficiency`` is the achieved FLOP rate over the nominal
-    per-backend peak (EVOTORCH_PEAK_FLOPS overrides;
-    observability.report.NOMINAL_PEAK_FLOPS documents the defaults)."""
+    ``flops_per_step`` is the cost model's FLOPs per counted env-step — a
+    program-cost fingerprint, NOT a utilization proxy: XLA's HloCostAnalysis
+    counts a while-loop body ONCE (the rollout loop is undercounted by its
+    trip count) while one-shot tensor work like a dense ask's (N, L)
+    materialization is counted in full, so comparing policy FORMS on it
+    inverts the truth. ``model_efficiency`` is therefore MFU-style: the
+    achieved MODEL FLOP rate — 2 * param_count useful FLOPs per counted
+    env-step (every lane-step runs the policy once; overhead and redundant
+    work count AGAINST utilization) — over the nominal per-backend peak
+    (EVOTORCH_PEAK_FLOPS overrides;
+    observability.report.NOMINAL_PEAK_FLOPS documents the defaults). Needs
+    ``param_count``; callers without it get a null column."""
     import jax
 
     from evotorch_tpu.observability.report import peak_flops
@@ -393,8 +446,8 @@ def ledger_columns(record, *, steps_per_sec, steps_per_generation):
         flops_per_step = record.flops / steps_per_generation
     efficiency = None
     peak = peak_flops(jax.devices()[0].platform)
-    if flops_per_step is not None and steps_per_sec and peak:
-        efficiency = flops_per_step * steps_per_sec / peak
+    if param_count and steps_per_sec and peak:
+        efficiency = 2.0 * param_count * steps_per_sec / peak
     return {
         "compile_seconds": round(record.compile_seconds, 3),
         "flops_per_step": (

@@ -26,7 +26,10 @@ chip (mesh of 1).
 
 Knobs: the same BENCH_* env vars as bench.py, plus ``BENCH_MESH`` (``"8"``
 = 1-D pop mesh of 8, ``"4x2"`` / ``"pop=4,model=2"`` = 2-D; default all
-local devices on ``pop``) and ``BENCH_SPMD`` above. The refill schedule
+local devices on ``pop``) and ``BENCH_SPMD`` above. ``BENCH_TRUNK_DELTA=1``
+evaluates the shared-trunk + per-lane low-rank-delta form (GSPMD path only:
+the evaluator pins the trunk to the ``model`` axis, the per-lane
+coefficients to ``pop`` — docs/policies.md). The refill schedule
 resolves through the tuned-config cache under THIS mesh's label (a width
 tuned unsharded is not evidence for a sharded layout). With BENCH_LEDGER
 on (default), the generation program is AOT-captured into the program
@@ -52,6 +55,7 @@ from bench_common import (
     ledger_columns,
     refill_kwargs,
     setup_backend,
+    tuned_policy,
     tuned_refill,
 )
 
@@ -65,7 +69,12 @@ def main():
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-    from evotorch_tpu.algorithms.functional import pgpe_ask, pgpe_tell
+    from evotorch_tpu.algorithms.functional import (
+        pgpe_ask,
+        pgpe_ask_trunk_delta,
+        pgpe_tell,
+        pgpe_tell_trunk_delta,
+    )
     from evotorch_tpu.analysis import track_compiles
     from evotorch_tpu.envs import make_env
     from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
@@ -106,7 +115,16 @@ def main():
         variants = {"gspmd": ["gspmd"], "shard_map": ["shard_map"],
                     "ab": ["gspmd", "shard_map"]}[spmd]
 
+    trunk_delta = cfg["trunk_delta"]
     needs_legacy = any(v in ("shard_map", "host_compact") for v in variants)
+    if trunk_delta and needs_legacy:
+        # the trunk-delta population shards through the GSPMD evaluator's
+        # pytree-aware constraints (trunk over `model`, coeffs over `pop`);
+        # the explicit shard_map / host-compact harnesses here are dense-only
+        raise SystemExit(
+            "BENCH_TRUNK_DELTA=1 needs the GSPMD path (BENCH_SPMD=gspmd, "
+            f"eval_mode != episodes_compact); got spmd variants {variants}"
+        )
     if needs_legacy:
         sharded_axes = [n for n, s in mesh.shape.items() if int(s) > 1]
         if sharded_axes not in ([], ["pop"]):
@@ -137,6 +155,12 @@ def main():
     # whole-generation program for the ledger, or None (host_compact)
     refill_src = None
 
+    trunk_cfg, trunk_src = {}, None
+    if trunk_delta:
+        trunk_cfg, trunk_src = tuned_policy(
+            cfg, params=policy.parameter_count, mesh_label=mesh_label_of(mesh)
+        )
+
     def build_gspmd():
         nonlocal refill_src
         rkw = {}
@@ -146,11 +170,27 @@ def main():
             rkw, refill_src = tuned_refill(
                 cfg, params=policy.parameter_count, mesh_label=mesh_label_of(mesh)
             )
+        if trunk_delta:
+            # shared-trunk + per-lane delta population: the evaluator pins
+            # the trunk (center + effective basis) to the `model` axis and
+            # the per-lane coefficients to `pop` (parallel/evaluate.py)
+            def ask_fn(k, s):
+                return pgpe_ask_trunk_delta(
+                    k, s, popsize=popsize, rank=trunk_cfg["rank"], policy=policy
+                )
+
+            tell_fn = pgpe_tell_trunk_delta
+            rkw["trunk_block"] = trunk_cfg["trunk_block"]
+        else:
+            def ask_fn(k, s):
+                return pgpe_ask(k, s, popsize=popsize)
+
+            tell_fn = pgpe_tell
         step = make_generation_step(
             env,
             policy,
-            ask=lambda k, s: pgpe_ask(k, s, popsize=popsize),
-            tell=pgpe_tell,
+            ask=ask_fn,
+            tell=tell_fn,
             popsize=popsize,
             mesh=mesh,
             num_episodes=1,
@@ -393,6 +433,7 @@ def main():
                 steps_per_sec=steps_per_sec,
                 steps_per_generation=runs[primary]["total_steps"]
                 / (repeats * generations),
+                param_count=policy.parameter_count,
             )
             if record is not None
             else {
@@ -429,6 +470,13 @@ def main():
     }
     if cfg["tuned"] and eval_mode == "episodes_refill" and refill_src is not None:
         line["tuned_config_source"] = refill_src
+    if trunk_delta:
+        # BENCH_TRUNK_DELTA=1 only (default line stays byte-compatible)
+        line["policy_form"] = "trunk_delta"
+        line["trunk_rank"] = trunk_cfg["rank"]
+        line["trunk_block"] = trunk_cfg["trunk_block"]
+        if cfg["tuned"]:
+            line["trunk_config_source"] = trunk_src
     if spmd == "ab":
         line["spmd_speedup"] = round(medians["gspmd"] / medians["shard_map"], 3)
         line["shard_map_value"] = round(medians["shard_map"], 1)

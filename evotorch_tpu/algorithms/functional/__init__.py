@@ -16,8 +16,10 @@ from .funcpgpe import (
     pgpe,
     pgpe_ask,
     pgpe_ask_lowrank,
+    pgpe_ask_trunk_delta,
     pgpe_tell,
     pgpe_tell_lowrank,
+    pgpe_tell_trunk_delta,
 )
 from .funcsnes import SNESState, snes, snes_ask, snes_tell
 from .funcxnes import XNESState, xnes, xnes_ask, xnes_tell
@@ -56,6 +58,8 @@ __all__ = [
     "pgpe_tell",
     "pgpe_ask_lowrank",
     "pgpe_tell_lowrank",
+    "pgpe_ask_trunk_delta",
+    "pgpe_tell_trunk_delta",
     "SNESState",
     "snes",
     "snes_ask",

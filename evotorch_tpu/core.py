@@ -44,7 +44,7 @@ from .operators.functional import pareto_ranks, pareto_utility
 from .tools.cloning import Serializable, deep_clone
 from .tools.hook import Hook
 from .tools.lazyreporter import LazyReporter
-from .tools.lowrank import LowRankParamsBatch, dense_values
+from .tools.lowrank import LowRankParamsBatch, dense_values, is_factored
 from .tools.misc import (
     ensure_array_length_and_dtype,
     is_dtype_bool,
@@ -670,7 +670,7 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         # from programs compiled over different meshes, and mixing their
         # placements in one jit call is an error
         values = batch.values
-        if isinstance(values, LowRankParamsBatch):
+        if is_factored(values):
             # find the winner COEFFICIENT rows, then densify only those K
             # rows — the full (N, L) population is never built
             cbv, cbe, cwv, cwe = _batch_extremes(values.coeffs, batch.evals, senses)
@@ -936,11 +936,10 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
                     break
                 prev_made = made
             if lowrank_rank is not None:
-                first_chunk = sample_chunks[0]
-                all_samples = LowRankParamsBatch(
-                    center=first_chunk.center,
-                    basis=first_chunk.basis,
-                    coeffs=jnp.concatenate([c.coeffs for c in sample_chunks], axis=0),
+                # _replace keeps the concrete factored class (low-rank or
+                # trunk-delta): shared center/basis/factors ride along
+                all_samples = sample_chunks[0]._replace(
+                    coeffs=jnp.concatenate([c.coeffs for c in sample_chunks], axis=0)
                 )
             else:
                 all_samples = jnp.concatenate(sample_chunks, axis=0)
@@ -954,7 +953,7 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         )
         num_solutions = (
             all_samples.popsize
-            if isinstance(all_samples, LowRankParamsBatch)
+            if is_factored(all_samples)
             else int(all_samples.shape[0])
         )
         result = {
@@ -965,7 +964,7 @@ class Problem(TensorMakerMixin, LazyReporter, Serializable, RecursivePrintable):
         hook_results = self.after_grad_hook.accumulate_dict(result)
         if hook_results:
             self.update_status(hook_results)
-        if isinstance(all_samples, LowRankParamsBatch):
+        if is_factored(all_samples):
             # the generation's basis, for the subspace-exhaustion diagnostic
             # (gaussian.py:_update_basis_capture); attached after the hook
             # pass so hook payloads keep the reference's key set
@@ -1140,11 +1139,12 @@ class SolutionBatch(Serializable, RecursivePrintable):
                 raise ValueError("merging_of needs at least one batch")
             first = batches[0]
             self._problem = first._problem
-            if any(isinstance(b._values, LowRankParamsBatch) for b in batches):
-                if not all(isinstance(b._values, LowRankParamsBatch) for b in batches):
+            if any(is_factored(b._values) for b in batches):
+                factored_cls = type(first._values)
+                if not all(type(b._values) is factored_cls for b in batches):
                     raise TypeError(
-                        "Cannot concatenate factored (low-rank) batches with "
-                        "dense ones; materialize the factored side first "
+                        "Cannot concatenate factored batches with dense ones "
+                        "or with a different factored form; materialize first "
                         "(batch.values.materialize())"
                     )
 
@@ -1170,10 +1170,10 @@ class SolutionBatch(Serializable, RecursivePrintable):
                         "against different bases have no shared factored "
                         "form — materialize first (batch.values.materialize())"
                     )
-                self._values = LowRankParamsBatch(
-                    center=fv.center,
-                    basis=fv.basis,
-                    coeffs=jnp.concatenate([b._values.coeffs for b in batches], axis=0),
+                # _replace keeps the concrete factored class; shared
+                # center/basis (and trunk-delta factors) ride along
+                self._values = fv._replace(
+                    coeffs=jnp.concatenate([b._values.coeffs for b in batches], axis=0)
                 )
                 self._evdata = jnp.concatenate([b._evdata for b in batches], axis=0)
                 return
@@ -1204,8 +1204,8 @@ class SolutionBatch(Serializable, RecursivePrintable):
                     # fancy indexing copies; writes propagate via
                     # _scatter_object_values instead
                     self._values = source._values[list(indices)]
-            elif isinstance(source._values, LowRankParamsBatch):
-                # gather coefficient lanes; center/basis are shared
+            elif is_factored(source._values):
+                # gather coefficient lanes; center/basis/factors are shared
                 self._values = source._values.take(jnp.asarray(indices))
             else:
                 self._values = source._values[jnp.asarray(indices)]
@@ -1226,7 +1226,7 @@ class SolutionBatch(Serializable, RecursivePrintable):
             if isinstance(values, ObjectArray):
                 self._values = values
                 popsize = len(values)
-            elif isinstance(values, LowRankParamsBatch):
+            elif is_factored(values):
                 # factored population: theta_i = center + basis @ coeffs[i]
                 # stored as-is — the dense (N, L) matrix is never built here
                 self._values = values
@@ -1264,7 +1264,7 @@ class SolutionBatch(Serializable, RecursivePrintable):
     def __len__(self) -> int:
         if isinstance(self._values, ObjectArray):
             return len(self._values)
-        if isinstance(self._values, LowRankParamsBatch):
+        if is_factored(self._values):
             return self._values.popsize
         return int(self._values.shape[0])
 
@@ -1311,11 +1311,11 @@ class SolutionBatch(Serializable, RecursivePrintable):
 
     def set_values(self, values, *, keep_evals: bool = False):
         """Replace decision values (reference ``core.py:3950``)."""
-        if isinstance(self._values, LowRankParamsBatch):
-            if not isinstance(values, LowRankParamsBatch):
+        if is_factored(self._values):
+            if type(values) is not type(self._values):
                 raise TypeError(
-                    "This batch holds a factored (low-rank) population; "
-                    "set_values expects another LowRankParamsBatch of the "
+                    "This batch holds a factored population; set_values "
+                    f"expects another {type(self._values).__name__} of the "
                     "same popsize"
                 )
             if values.popsize != len(self):
@@ -1605,7 +1605,7 @@ class Solution(Serializable, RecursivePrintable):
 
     @property
     def values(self):
-        if isinstance(self._batch._values, LowRankParamsBatch):
+        if is_factored(self._batch._values):
             # densify just this row: center + basis @ coeffs[i]
             lr = self._batch._values
             return lr.materialize_rows(lr.coeffs[self._index][None])[0]
@@ -1621,10 +1621,10 @@ class Solution(Serializable, RecursivePrintable):
         return not bool(jnp.any(jnp.isnan(self.evals[:n_obj])))
 
     def set_values(self, values):
-        if isinstance(self._batch._values, LowRankParamsBatch):
+        if is_factored(self._batch._values):
             raise NotImplementedError(
                 "Writing a single solution's values into a factored "
-                "(low-rank) batch is not supported: an arbitrary dense row "
+                "batch is not supported: an arbitrary dense row "
                 "generally has no representation in the batch's basis"
             )
         if isinstance(self._batch._values, ObjectArray):
@@ -1683,7 +1683,7 @@ class Solution(Serializable, RecursivePrintable):
         problem = self.problem
         if isinstance(self._batch._values, ObjectArray):
             values = ObjectArray.from_values([self._batch._values[self._index]])
-        elif isinstance(self._batch._values, LowRankParamsBatch):
+        elif is_factored(self._batch._values):
             values = self.values[None]
         else:
             values = self._batch._values[self._index][None]

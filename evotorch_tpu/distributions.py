@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from .tools.cloning import Serializable
-from .tools.lowrank import LowRankParamsBatch
+from .tools.lowrank import LowRankParamsBatch, TrunkDeltaParamsBatch, is_factored
 from .tools.misc import to_jax_dtype
 from .tools.ranking import rank
 from .tools.recursiveprintable import RecursivePrintable
@@ -217,7 +217,7 @@ class Distribution(TensorMakerMixin, Serializable, RecursivePrintable):
             raise ValueError(f"objective_sense must be 'min' or 'max', got {objective_sense!r}")
         higher_is_better = objective_sense == "max"
         arrays, static = _split_params(self._parameters)
-        if not isinstance(samples, LowRankParamsBatch):
+        if not is_factored(samples):
             samples = jnp.asarray(samples)  # structured samples are pytrees already
         return _jitted_grads_for(type(self))(
             arrays, samples, jnp.asarray(fitnesses), static, ranking_method, higher_is_better
@@ -452,7 +452,10 @@ class SymmetricSeparableGaussian(SeparableGaussian):
 
     @classmethod
     def _compute_gradients(cls, parameters, samples, weights, ranking_used) -> dict:
-        if isinstance(samples, LowRankParamsBatch):
+        if is_factored(samples):
+            # both factored forms expose the same center/basis/coeffs algebra
+            # (tools.lowrank.FACTORED_BATCH_TYPES); the gradient math below
+            # reads only .basis/.coeffs, so it covers trunk-delta batches too
             return cls._compute_gradients_lowrank(parameters, samples, weights, ranking_used)
         if "parenthood_ratio" in parameters:
             return cls._compute_gradients_via_parenthood_ratio(parameters, samples, weights)
@@ -572,6 +575,22 @@ class SymmetricSeparableGaussian(SeparableGaussian):
             parameters, "sigma", (rowquad - jnp.sum(w_s) * sigma**2) / sigma, weights
         )
         return {"mu": mu_grad, "sigma": sigma_grad}
+
+    @classmethod
+    def _sample_trunk_delta(
+        cls, key, parameters, num_solutions, rank, factors, basis
+    ) -> TrunkDeltaParamsBatch:
+        """Draw a ``TrunkDeltaParamsBatch`` against an externally-structured
+        (factors, effective-basis) pair — the shared-trunk policy form
+        (``neuroevolution/net/lowrank.py``'s ``sample_trunk_delta_factors``
+        draws the pair; the structure is policy-shaped, so it cannot be
+        drawn here). The antithetic coefficient layout is exactly
+        :meth:`_sample_lowrank`'s, so gradients, concatenation and the
+        guardrail see an ordinary factored batch."""
+        lr = cls._sample_lowrank(key, parameters, num_solutions, rank, basis=basis)
+        return TrunkDeltaParamsBatch(
+            center=lr.center, basis=lr.basis, coeffs=lr.coeffs, factors=factors
+        )
 
 
 

@@ -44,10 +44,22 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ...tools.lowrank import LowRankParamsBatch
+from jax.flatten_util import ravel_pytree
+
+from ...tools.lowrank import LowRankParamsBatch, TrunkDeltaParamsBatch
 from .layers import LSTM, RNN, Bias, Linear, Module, Sequential
 
-__all__ = ["LowRankParamsBatch", "lowrank_supported", "prepare_lowrank", "lowrank_forward"]
+__all__ = [
+    "LowRankParamsBatch",
+    "TrunkDeltaParamsBatch",
+    "lowrank_supported",
+    "prepare_lowrank",
+    "lowrank_forward",
+    "trunk_delta_supported",
+    "sample_trunk_delta_factors",
+    "prepare_trunk_delta",
+    "trunk_delta_forward",
+]
 
 
 def lowrank_supported(module: Module) -> bool:
@@ -209,6 +221,268 @@ def lowrank_forward(
         f"low-rank forward fell back to materializing the dense "
         f"({params.popsize}, {params.center.shape[-1]}) population: "
         f"{type(module).__name__} has no structured low-rank path "
+        "(supported: Sequential stacks of Linear/Bias/RNN/LSTM/"
+        "parameterless layers)",
+        stacklevel=2,
+    )
+    dense = params.materialize()
+    if states is None:
+        return jax.vmap(lambda p, o: policy(p, o))(dense, obs)
+    return jax.vmap(policy)(dense, obs, states)
+
+
+# ---------------------------------------------------------------------------
+# the shared-trunk + per-lane low-rank-delta form (docs/policies.md)
+#
+# The augmented matmul above still pays (k+1) trunk-sized matmuls per layer.
+# Structuring each basis column as a RANK-1 block per 2-D weight —
+# ``D_m = b_m a_m^T`` — collapses the per-layer forward to
+#
+#     y = x @ W_c^T + ((x @ A) * z) @ B^T        A: (in, k), B: (out, k)
+#
+# ONE trunk GEMM over the whole population batch (the weight is loaded once
+# for every lane — real MXU arithmetic intensity) plus two thin shared
+# GEMMs; per-lane cost drops from (k+1)·in·out to in·out + k·(in+out).
+# ---------------------------------------------------------------------------
+
+
+class _Factor(NamedTuple):
+    """Per-parameter-leaf delta factors. For a 2-D weight leaf ``a`` is
+    (in, k) and ``b`` is (out, k) with sigma's block scale folded into
+    ``b``; for a 1-D leaf ``a`` is an empty (0, k) placeholder and ``b``
+    holds the sigma-folded dense direction matrix (size, k) — exactly a
+    low-rank bias basis."""
+
+    a: jnp.ndarray
+    b: jnp.ndarray
+
+
+def trunk_delta_supported(module: Module) -> bool:
+    """The trunk-delta path covers the same structured stacks as the
+    augmented-matmul path: Sequential pipelines of Linear / Bias / RNN /
+    LSTM / parameterless layers."""
+    return lowrank_supported(module)
+
+
+def sample_trunk_delta_factors(key, policy, sigma: jnp.ndarray, rank: int):
+    """Draw one generation's delta factors and materialize their effective
+    basis.
+
+    Returns ``(factors, basis)``: ``factors`` is a pytree mirroring the
+    policy's parameter tree with a :class:`_Factor` at every leaf, and
+    ``basis`` is the flat (L, k) effective basis whose column ``m`` is the
+    concatenation of ``vec(b_m a_m^T)`` (2-D leaves) and the 1-D direction
+    columns — the SAME ``theta_i = center + basis @ z_i`` algebra as
+    :class:`LowRankParamsBatch`, so gradients and the exhaustion guardrail
+    apply unchanged.
+
+    Sigma folding: 1-D leaves fold the per-parameter sigma exactly; 2-D
+    leaves fold the block's RMS sigma (a per-parameter scale would break
+    the rank-1 structure the fast forward depends on). Per-entry delta
+    variance is ``sigma^2`` (blockwise for matrices), matching the default
+    low-rank basis scaling at equal rank.
+    """
+    sigma_tree = policy.unravel(sigma)
+    leaves, treedef = jax.tree_util.tree_flatten(sigma_tree)
+    factor_nodes = []
+    basis_leaves = []
+    inv_sqrt_k = 1.0 / jnp.sqrt(jnp.asarray(float(rank), sigma.dtype))
+    for i, sigma_leaf in enumerate(leaves):
+        k_a = jax.random.fold_in(key, 2 * i)
+        k_b = jax.random.fold_in(key, 2 * i + 1)
+        if sigma_leaf.ndim == 2:
+            out_f, in_f = sigma_leaf.shape
+            a = jax.random.normal(k_a, (in_f, rank), sigma_leaf.dtype)
+            block_rms = jnp.sqrt(jnp.mean(sigma_leaf * sigma_leaf))
+            b = jax.random.normal(k_b, (out_f, rank), sigma_leaf.dtype) * (
+                block_rms * inv_sqrt_k
+            )
+            factor_nodes.append(_Factor(a=a, b=b))
+            basis_leaves.append(jnp.einsum("om,im->oim", b, a))
+        elif sigma_leaf.ndim == 1:
+            dirs = (
+                jax.random.normal(k_b, sigma_leaf.shape + (rank,), sigma_leaf.dtype)
+                * inv_sqrt_k
+                * sigma_leaf[:, None]
+            )
+            factor_nodes.append(
+                _Factor(a=jnp.zeros((0, rank), sigma_leaf.dtype), b=dirs)
+            )
+            basis_leaves.append(dirs)
+        else:
+            raise ValueError(
+                "trunk-delta factors need 1-D or 2-D parameter leaves; got "
+                f"shape {sigma_leaf.shape} (leaf {i})"
+            )
+    factors = jax.tree_util.tree_unflatten(treedef, factor_nodes)
+    basis_tree = jax.tree_util.tree_unflatten(treedef, basis_leaves)
+    basis = jax.vmap(lambda t: ravel_pytree(t)[0], in_axes=-1, out_axes=-1)(
+        basis_tree
+    )
+    return factors, basis
+
+
+class _TrunkPrepared(NamedTuple):
+    """Loop-invariant forward context of a trunk-delta rollout: the
+    unraveled trunk tree, the factor tree, the per-lane coefficients, and
+    the static lane-block size (0 = single block; the autotuner's ``policy``
+    knob group searches it)."""
+
+    center_tree: Any
+    factors: Any
+    coeffs: jnp.ndarray
+    trunk_block: int = 0
+
+
+def prepare_trunk_delta(
+    policy, params: TrunkDeltaParamsBatch, *, trunk_block: int = 0
+) -> _TrunkPrepared:
+    """Split the flat trunk into its per-layer tree. Cheap; call once per
+    rollout, outside the stepping loop."""
+    return _TrunkPrepared(
+        policy.unravel(params.center), params.factors, params.coeffs, int(trunk_block)
+    )
+
+
+def _trunk_matmul(W_c, fac: _Factor, z, x):
+    """``x`` (B, in) times the per-lane effective weight
+    ``W_i = W_c + sum_m z_im b_m a_m^T``: one shared trunk GEMM plus the
+    thin delta GEMMs. Returns (B, out)."""
+    return x @ W_c.T + ((x @ fac.a) * z) @ fac.b.T
+
+
+def _linear_trunk(layer: Linear, cp, fx, z, x):
+    y = _trunk_matmul(cp["weight"], fx["weight"], z, x)
+    if layer.bias:
+        y = y + _lane_bias(cp["bias"], fx["bias"].b, z)
+    return y
+
+
+def _bias_trunk(layer: Bias, cp, fx, z, x):
+    return x + _lane_bias(cp["bias"], fx["bias"].b, z)
+
+
+def _rnn_trunk(layer: RNN, cp, fx, z, x, state):
+    if state is None:
+        state = jnp.zeros(x.shape[:-1] + (layer.hidden_size,), dtype=x.dtype)
+    pre = (
+        _trunk_matmul(cp["W_ih"], fx["W_ih"], z, x)
+        + _trunk_matmul(cp["W_hh"], fx["W_hh"], z, state)
+        + _lane_bias(cp["b_ih"], fx["b_ih"].b, z)
+        + _lane_bias(cp["b_hh"], fx["b_hh"].b, z)
+    )
+    h = jnp.tanh(pre) if layer.nonlinearity == "tanh" else jax.nn.relu(pre)
+    return h, h
+
+
+def _lstm_trunk(layer: LSTM, cp, fx, z, x, state):
+    if state is None:
+        h = jnp.zeros(x.shape[:-1] + (layer.hidden_size,), dtype=x.dtype)
+        c = jnp.zeros(x.shape[:-1] + (layer.hidden_size,), dtype=x.dtype)
+    else:
+        h, c = state
+    gates = (
+        _trunk_matmul(cp["W_ih"], fx["W_ih"], z, x)
+        + _trunk_matmul(cp["W_hh"], fx["W_hh"], z, h)
+        + _lane_bias(cp["b_ih"], fx["b_ih"].b, z)
+        + _lane_bias(cp["b_hh"], fx["b_hh"].b, z)
+    )
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return h, (h, c)
+
+
+def _apply_trunk_delta(module: Module, cp, fx, z, x, state):
+    """Whole-population trunk-delta forward, threading per-lane recurrent
+    state exactly like ``_apply_lowrank``. Returns ``(y, new_state)``."""
+    if isinstance(module, Sequential):
+        if state is None:
+            state = tuple(None for _ in module.modules)
+        new_states = []
+        for m, c, f, s in zip(module.modules, cp, fx, state):
+            x, ns = _apply_trunk_delta(m, c, f, z, x, s)
+            new_states.append(ns)
+        out_state = tuple(new_states)
+        if all(s is None for s in out_state):
+            out_state = None
+        return x, out_state
+    if isinstance(module, Linear):
+        return _linear_trunk(module, cp, fx, z, x), state
+    if isinstance(module, Bias):
+        return _bias_trunk(module, cp, fx, z, x), state
+    if isinstance(module, RNN):
+        return _rnn_trunk(module, cp, fx, z, x, state)
+    if isinstance(module, LSTM):
+        return _lstm_trunk(module, cp, fx, z, x, state)
+    # parameterless layer: batched apply is the plain apply
+    return module.apply(cp, x, state)
+
+
+def _apply_trunk_delta_blocked(module, cp, fx, z, obs, states, block: int):
+    """The same forward with the LANE axis chunked into static blocks of
+    ``block`` via ``lax.map`` — bounds the per-GEMM activation working set
+    (the autotuner's trunk-blocking knob). Per-lane results are independent,
+    so blocking changes scheduling, not values."""
+    n = obs.shape[0]
+    nb = n // block
+
+    def _split(t):
+        return t.reshape((nb, block) + t.shape[1:])
+
+    xs = (
+        _split(obs),
+        _split(z),
+        None
+        if states is None
+        else jax.tree_util.tree_map(_split, states),
+    )
+
+    def _body(args):
+        o, zz, ss = args
+        return _apply_trunk_delta(module, cp, fx, zz, o, ss)
+
+    y_b, ns_b = jax.lax.map(_body, xs)
+    y = y_b.reshape((n,) + y_b.shape[2:])
+    if ns_b is not None:
+        ns_b = jax.tree_util.tree_map(
+            lambda t: t.reshape((n,) + t.shape[2:]), ns_b
+        )
+    return y, ns_b
+
+
+def trunk_delta_forward(
+    policy,
+    params: TrunkDeltaParamsBatch,
+    prepared: Optional[_TrunkPrepared],
+    obs,
+    states,
+) -> Tuple[jnp.ndarray, Any]:
+    """Whole-population shared-trunk forward: ``obs`` (B, obs_dim) ->
+    (B, act_dim). Mirrors :func:`lowrank_forward`'s contract, including the
+    LOUD materializing fallback for unstructured modules."""
+    module = policy.module
+    if trunk_delta_supported(module):
+        if prepared is None:
+            prepared = prepare_trunk_delta(policy, params)
+        z = prepared.coeffs
+        block = int(prepared.trunk_block)
+        n = obs.shape[0]
+        if block > 0 and n > block and n % block == 0:
+            return _apply_trunk_delta_blocked(
+                module, prepared.center_tree, prepared.factors, z, obs, states, block
+            )
+        return _apply_trunk_delta(
+            module, prepared.center_tree, prepared.factors, z, obs, states
+        )
+    warnings.warn(
+        f"trunk-delta forward fell back to materializing the dense "
+        f"({params.popsize}, {params.center.shape[-1]}) population: "
+        f"{type(module).__name__} has no structured trunk-delta path "
         "(supported: Sequential stacks of Linear/Bias/RNN/LSTM/"
         "parameterless layers)",
         stacklevel=2,

@@ -45,8 +45,16 @@ from ...observability.devicemetrics import (
     pack_group_telemetry,
     queue_wait_bucket_index,
 )
+from ...tools.lowrank import is_factored
 from ..net.functional import FlatParamsPolicy
-from ..net.lowrank import LowRankParamsBatch, lowrank_forward, prepare_lowrank
+from ..net.lowrank import (
+    LowRankParamsBatch,
+    TrunkDeltaParamsBatch,
+    lowrank_forward,
+    prepare_lowrank,
+    prepare_trunk_delta,
+    trunk_delta_forward,
+)
 from ..net.rl import alive_bonus_for_step
 from ..net.runningnorm import CollectedStats, stats_normalize, stats_update
 
@@ -62,14 +70,17 @@ __all__ = [
 
 
 # ------------------- population-parameter representations -------------------
-# The engine accepts a population either as a dense (N, L) matrix or as a
-# LowRankParamsBatch (center + shared basis + per-lane coefficients — the MXU
-# path for wide policies, net/lowrank.py). These helpers are the only places
-# that care which one it is.
+# The engine accepts a population as a dense (N, L) matrix, a
+# LowRankParamsBatch (center + shared basis + per-lane coefficients — the
+# augmented-matmul MXU path, net/lowrank.py), or a TrunkDeltaParamsBatch
+# (shared trunk + rank-1-per-block deltas — the shared-trunk MXU path,
+# docs/policies.md). These helpers are the only places that care which one
+# it is; per-lane state lives ONLY in coeffs for both factored forms
+# (tools.lowrank.is_factored), so take/popsize generalize.
 
 
 def _params_popsize(params_batch) -> int:
-    if isinstance(params_batch, LowRankParamsBatch):
+    if is_factored(params_batch):
         return params_batch.popsize
     return params_batch.shape[0]
 
@@ -81,21 +92,27 @@ def _params_cast(params_batch, dtype):
 
 
 def _params_take(params_batch, idx):
-    if isinstance(params_batch, LowRankParamsBatch):
+    if is_factored(params_batch):
         return params_batch.take(idx)
     return params_batch[idx]
 
 
-def _forward_ctx(policy, params_batch):
+def _forward_ctx(policy, params_batch, trunk_block: int = 0):
     """Precompute the loop-invariant forward context (per-layer center/basis
-    trees for the low-rank path); call inside jit, OUTSIDE stepping loops."""
+    or trunk/factor trees for the factored paths); call inside jit, OUTSIDE
+    stepping loops. ``trunk_block`` is the static lane-block size of the
+    trunk-delta forward (0 = single block; ignored by the other forms)."""
+    if isinstance(params_batch, TrunkDeltaParamsBatch):
+        return prepare_trunk_delta(policy, params_batch, trunk_block=trunk_block)
     if isinstance(params_batch, LowRankParamsBatch):
         return prepare_lowrank(policy, params_batch)
     return None
 
 
 def _batched_forward(policy, params_batch, ctx, obs, states):
-    """Whole-population policy forward for either representation."""
+    """Whole-population policy forward for any representation."""
+    if isinstance(params_batch, TrunkDeltaParamsBatch):
+        return trunk_delta_forward(policy, params_batch, ctx, obs, states)
     if isinstance(params_batch, LowRankParamsBatch):
         return lowrank_forward(policy, params_batch, ctx, obs, states)
     if states is None:
@@ -685,6 +702,7 @@ def _make_step(
         "telemetry",
         "num_valid",
         "num_groups",
+        "trunk_block",
     ),
 )
 def run_vectorized_rollout(
@@ -711,8 +729,16 @@ def run_vectorized_rollout(
     num_valid: Optional[int] = None,
     groups=None,
     num_groups: int = 1,
+    trunk_block: int = 0,
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
+
+    ``trunk_block`` (trunk-delta populations only): static lane-block size
+    of the shared-trunk forward — the population batch is chunked into
+    blocks of that many lanes per trunk GEMM (``lax.map``), bounding the
+    activation working set. 0 (default) runs one full-width GEMM. Tuned by
+    the autotuner's ``policy`` knob group; a no-op for dense/low-rank
+    populations.
 
     ``telemetry`` (default on): accumulate the zero-sync observability
     counters in the loop carry and return them packed in
@@ -847,6 +873,7 @@ def run_vectorized_rollout(
             num_valid=num_valid,
             groups=groups,
             num_groups=num_groups,
+            trunk_block=trunk_block,
         )
     hard_cap = max_t * int(num_episodes) + 1
     budget_mode = eval_mode == "budget"
@@ -886,7 +913,7 @@ def run_vectorized_rollout(
         num_groups=num_groups,
     )
 
-    ctx = _forward_ctx(policy, params_batch)
+    ctx = _forward_ctx(policy, params_batch, trunk_block=int(trunk_block))
     if budget_mode:
         budget = max_t * int(num_episodes)
         final = jax.lax.fori_loop(
@@ -1022,14 +1049,65 @@ def _default_refill_width(total_items: int) -> int:
     return min(total_items, max(128, _pow2_at_least(max(1, total_items // 8))))
 
 
-def _refill_forward_setup(policy, params_batch):
+def _refill_forward_setup(policy, params_batch, trunk_block: int = 0):
     """Per-lane parameter storage + forward for the refill engine.
 
     The loop carries only the PER-LANE slice of the population (dense rows,
-    or low-rank coefficients — the shared center/basis stay loop-invariant
-    closures), so a refill gathers O(W x row), never the whole population.
-    Returns ``(store, forward)``: ``store`` is the (N, row) gather source and
-    ``forward(lane_params, obs, states)`` runs the policy at width W."""
+    or factored coefficients — the shared center/basis/factors stay
+    loop-invariant closures), so a refill gathers O(W x row), never the
+    whole population. Returns ``(store, forward)``: ``store`` is the
+    (N, row) gather source and ``forward(lane_params, obs, states)`` runs
+    the policy at width W."""
+    if isinstance(params_batch, TrunkDeltaParamsBatch):
+        from .lowrank import (
+            _apply_trunk_delta,
+            _apply_trunk_delta_blocked,
+            prepare_trunk_delta,
+            trunk_delta_supported,
+        )
+
+        if trunk_delta_supported(policy.module):
+            prepared = prepare_trunk_delta(policy, params_batch)
+            blk = int(trunk_block)
+
+            def forward(lane_coeffs, obs, states):
+                w = obs.shape[0]
+                if blk > 0 and w > blk and w % blk == 0:
+                    return _apply_trunk_delta_blocked(
+                        policy.module,
+                        prepared.center_tree,
+                        prepared.factors,
+                        lane_coeffs,
+                        obs,
+                        states,
+                        blk,
+                    )
+                return _apply_trunk_delta(
+                    policy.module,
+                    prepared.center_tree,
+                    prepared.factors,
+                    lane_coeffs,
+                    obs,
+                    states,
+                )
+
+        else:
+            import warnings
+
+            warnings.warn(
+                "trunk-delta refill forward fell back to materializing dense "
+                f"per-lane parameter rows (W, {params_batch.center.shape[-1]}) "
+                f"every step: {type(policy.module).__name__} has no "
+                "structured trunk-delta path (supported: Sequential stacks "
+                "of Linear/Bias/RNN/LSTM/parameterless layers)",
+                stacklevel=3,
+            )
+
+            def forward(lane_coeffs, obs, states):
+                dense = params_batch.materialize_rows(lane_coeffs)
+                return _batched_forward(policy, dense, None, obs, states)
+
+        return params_batch.coeffs, forward
     if isinstance(params_batch, LowRankParamsBatch):
         from .lowrank import _apply_lowrank, lowrank_supported, prepare_lowrank
 
@@ -1096,6 +1174,7 @@ def _run_refill(
     num_valid=None,
     groups=None,
     num_groups=1,
+    trunk_block=0,
 ) -> RolloutResult:
     """The ``episodes_refill`` evaluation: exact ``episodes`` semantics (each
     solution is scored by the mean return of exactly ``num_episodes``
@@ -1123,7 +1202,9 @@ def _run_refill(
     params_batch = _params_cast(params_batch, compute_dtype)
     if lane_ids is None:
         lane_ids = jnp.arange(n, dtype=jnp.int32)
-    store, forward = _refill_forward_setup(policy, params_batch)
+    store, forward = _refill_forward_setup(
+        policy, params_batch, trunk_block=int(trunk_block)
+    )
 
     collect_groups = bool(telemetry) and int(num_groups) > 1 and groups is not None
     groups_arr = (
@@ -1888,12 +1969,27 @@ def global_lane_ids(axis_name: str, n_local: int) -> jnp.ndarray:
     return rank * n_local + jnp.arange(n_local, dtype=jnp.int32)
 
 
-def _params_shard_spec(lowrank: bool, axis_name: str):
+def _params_kind(params_batch) -> str:
+    """Hashable representation tag for the lru-cached sharded builders."""
+    if isinstance(params_batch, TrunkDeltaParamsBatch):
+        return "trunk_delta"
+    if isinstance(params_batch, LowRankParamsBatch):
+        return "lowrank"
+    return "dense"
+
+
+def _params_shard_spec(params_kind: str, axis_name: str):
     from jax.sharding import PartitionSpec as P
 
-    if lowrank:
+    if params_kind == "lowrank":
         # coefficients shard; the shared center/basis replicate
         return LowRankParamsBatch(center=P(), basis=P(), coeffs=P(axis_name))
+    if params_kind == "trunk_delta":
+        # coefficients shard; trunk, effective basis and the factor tree
+        # replicate (factors=P() is a pytree-prefix spec over the subtree)
+        return TrunkDeltaParamsBatch(
+            center=P(), basis=P(), coeffs=P(axis_name), factors=P()
+        )
     return P(axis_name)
 
 
@@ -1911,7 +2007,7 @@ def _compacting_sharded_fns(
     compute_dtype,
     mesh,
     axis_name: str,
-    lowrank: bool,
+    params_kind: str,
     stats_sync: bool = False,
     collect_telemetry: bool = True,
     num_groups: int = 1,
@@ -1935,7 +2031,7 @@ def _compacting_sharded_fns(
         num_groups=num_groups,
     )
     carry_specs = _sharded_carry_specs(env, axis_name)
-    params_spec = _params_shard_spec(lowrank, axis_name)
+    params_spec = _params_shard_spec(params_kind, axis_name)
     lane = P(axis_name)
 
     if num_groups > 1:
@@ -2164,7 +2260,7 @@ def run_vectorized_rollout_compacting_sharded(
         compute_dtype,
         mesh,
         str(axis_name),
-        isinstance(params_batch, LowRankParamsBatch),
+        _params_kind(params_batch),
         bool(stats_sync),
         bool(telemetry),
         num_groups,

@@ -24,7 +24,7 @@ import numpy as np
 from ..core import SolutionBatch
 from ..envs import Env, make_env
 from ..observability.timings import canonical_env_label, resolve_knobs
-from ..tools.lowrank import LowRankParamsBatch
+from ..tools.lowrank import LowRankParamsBatch, is_factored
 from ..parallel.mesh import default_mesh
 from .neproblem import NEProblem
 from .net.layers import Module
@@ -454,9 +454,10 @@ class VecNE(NEProblem):
             self.evaluate_sharded(batch, mesh=mesh)
             return
         values = batch.values
-        if not isinstance(values, LowRankParamsBatch):
-            # a factored (low-rank) population stays factored all the way into
-            # the rollout engine — the dense (N, L) matrix is never built
+        if not is_factored(values):
+            # a factored population (low-rank or trunk-delta) stays factored
+            # all the way into the rollout engine — the dense (N, L) matrix
+            # is never built
             values = jnp.asarray(values)
         n = len(batch)
         groups = self._check_solution_groups(n)
@@ -468,7 +469,7 @@ class VecNE(NEProblem):
                 stop = min(start + self._max_num_envs, n)
                 piece = (
                     values.take(jnp.arange(start, stop))
-                    if isinstance(values, LowRankParamsBatch)
+                    if is_factored(values)
                     else values[start:stop]
                 )
                 result = self._rollout_batch(
@@ -617,7 +618,7 @@ class VecNE(NEProblem):
             mesh = default_mesh((axis_name,))
         n_shards = mesh.shape[axis_name]
         values = batch.values
-        is_lowrank = isinstance(values, LowRankParamsBatch)
+        is_lowrank = is_factored(values)
         if not is_lowrank:
             values = jnp.asarray(values)
         n = len(batch)

@@ -84,6 +84,7 @@ __all__ = [
     "HostPipelineHarness",
     "KnobGroup",
     "KnobSpec",
+    "PolicyHarness",
     "RefillHarness",
     "SearchOutcome",
     "analytic_prune",
@@ -285,13 +286,25 @@ def successive_halving(
 
 
 def select_winner(
-    results: Sequence[CandidateStats], *, min_occupancy: Optional[float] = None
+    results: Sequence[CandidateStats],
+    *,
+    min_occupancy: Optional[float] = None,
+    tolerance: Optional[float] = None,
+    prefer: Optional[Callable[[Dict[str, Any]], Any]] = None,
 ) -> Optional[CandidateStats]:
     """Highest median steps/s among measured candidates meeting the
     occupancy floor — falling back to the unconstrained winner when none
     do (a floor must never select nothing). Candidates that paid a
     steady-state compile mid-trial are untrustworthy timings and lose to
-    any clean candidate."""
+    any clean candidate.
+
+    ``tolerance`` + ``prefer`` select on a SECONDARY objective inside a
+    throughput band: among candidates whose median steps/s is within
+    ``tolerance`` (a fraction) of the best, the one maximizing
+    ``prefer(config)`` wins, with throughput breaking preference ties.
+    The policy group uses this — expressivity (rank) is worth a bounded
+    throughput haircut, so the highest rank within the band wins rather
+    than the outright-fastest rank-4 corner."""
     measured = [r for r in results if r.samples]
     if not measured:
         return None
@@ -303,7 +316,12 @@ def select_winner(
         ]
         if eligible:
             pool = eligible
-    return max(pool, key=lambda r: r.steps_per_sec)
+    best = max(pool, key=lambda r: r.steps_per_sec)
+    if tolerance is None or prefer is None:
+        return best
+    floor = best.steps_per_sec * (1.0 - float(tolerance))
+    near = [r for r in pool if r.steps_per_sec >= floor]
+    return max(near, key=lambda r: (prefer(r.config), r.steps_per_sec))
 
 
 @dataclass
@@ -333,13 +351,17 @@ def autotune_search(
     min_survivors: int = 2,
     max_rounds: int = 2,
     min_occupancy: Optional[float] = None,
+    tolerance: Optional[float] = None,
+    prefer: Optional[Callable[[Dict[str, Any]], Any]] = None,
     refine: bool = True,
 ) -> SearchOutcome:
     """The full (pure) search: grid → analytic prune → successive
     halving → winner → one neighborhood-refinement round around the
     winner (off-grid midpoints, themselves prune-checked) → final
     winner. ``measure``/``cost_fn`` carry all the impurity; everything
-    here is deterministic given their outputs."""
+    here is deterministic given their outputs. ``tolerance``/``prefer``
+    pass through to :func:`select_winner` (secondary-objective
+    selection inside a throughput band)."""
     grid = candidate_grid(group)
     kept, pruned, costs = analytic_prune(
         grid, cost_fn, hbm_budget_bytes=hbm_budget_bytes, flops_bound=flops_bound
@@ -354,7 +376,9 @@ def autotune_search(
     )
     for index, cost in costs.items():
         results[index].cost = cost
-    winner = select_winner(results, min_occupancy=min_occupancy)
+    winner = select_winner(
+        results, min_occupancy=min_occupancy, tolerance=tolerance, prefer=prefer
+    )
     if refine and winner is not None:
         measured_keys = {tuple(sorted(r.config.items())) for r in results}
         fresh = [
@@ -381,7 +405,12 @@ def autotune_search(
             for index, cost in costs2.items():
                 refined[index].cost = cost
             results = results + refined
-            winner = select_winner(results, min_occupancy=min_occupancy)
+            winner = select_winner(
+                results,
+                min_occupancy=min_occupancy,
+                tolerance=tolerance,
+                prefer=prefer,
+            )
     return SearchOutcome(results=results, pruned=pruned, winner=winner)
 
 
@@ -420,6 +449,11 @@ class _BespokeHarness:
     program = ""  # timing-ledger program name
     #: per-group winner floor (subclasses override; None = throughput only)
     default_min_occupancy: Optional[float] = None
+    #: secondary-objective selection (select_winner's tolerance/prefer):
+    #: None on throughput-only groups; the policy group trades a bounded
+    #: throughput haircut for rank
+    winner_tolerance: Optional[float] = None
+    winner_prefer: Optional[Callable[[Dict[str, Any]], Any]] = None
 
     def __init__(self, shape: TuneShape, *, seed: int = 0):
         import jax
@@ -808,6 +842,199 @@ class CompactHarness(_BespokeHarness):
         }
 
 
+class PolicyHarness(_BespokeHarness):
+    """Tunes the trunk-delta POLICY FORM knobs: delta rank × lane-block
+    size (docs/policies.md). Unlike the schedule groups, each rank
+    candidate evaluates its OWN factored population (same trunk, same
+    base PRNG key) — rank changes the program being measured, not just
+    its schedule — so the harness keeps one ``TrunkDeltaParamsBatch``
+    per rank, built once. Selection is throughput-within-tolerance with
+    rank as the preference: a higher rank buys expressivity (more
+    sampling subspace per generation — the subspace-exhaustion guardrail
+    bites later), so the HIGHEST rank within ``winner_tolerance`` of the
+    fastest candidate wins rather than the outright-fastest low-rank
+    corner."""
+
+    group = "policy"
+    program = "rollout.budget.trunk_delta"
+    #: the budget contract keeps every lane active; throughput selection
+    default_min_occupancy: Optional[float] = None
+    #: the rank-preference band: a candidate within 10% of the fastest
+    #: median is "as fast" on this box's ±20% timing noise
+    winner_tolerance: Optional[float] = 0.1
+    winner_prefer = staticmethod(lambda config: int(config.get("rank", 0)))
+
+    def __init__(
+        self,
+        shape: TuneShape,
+        *,
+        ranks: Sequence[int] = (4, 16, 64),
+        trunk_blocks: Sequence[int] = (0,),
+        seed: int = 0,
+    ):
+        super().__init__(shape, seed=seed)
+        self.ranks = tuple(sorted({int(r) for r in ranks if int(r) > 0}))
+        if not self.ranks:
+            raise ValueError("empty rank menu; pass --ranks with positive ints")
+        # the blocked lane path requires popsize % block == 0 (vecrl's
+        # trunk_block contract); 0 = unblocked is always valid
+        self.trunk_blocks = tuple(
+            sorted(
+                {
+                    int(b)
+                    for b in trunk_blocks
+                    if int(b) == 0
+                    or (0 < int(b) < shape.popsize and shape.popsize % int(b) == 0)
+                }
+            )
+        )
+        if not self.trunk_blocks:
+            self.trunk_blocks = (0,)
+        self._rank_batches: Dict[int, Any] = {}
+        self._seed = int(seed)
+
+    def _params_for(self, rank: int):
+        """The rank's trunk-delta population, built once per search: every
+        candidate at this rank (and every trial) times the SAME batch."""
+        rank = int(rank)
+        if rank not in self._rank_batches:
+            import jax
+            import jax.numpy as jnp
+
+            from ..algorithms.functional import pgpe, pgpe_ask_trunk_delta
+
+            state = pgpe(
+                center_init=jnp.zeros(
+                    self.policy.parameter_count, dtype=jnp.float32
+                ),
+                center_learning_rate=0.1,
+                stdev_learning_rate=0.1,
+                objective_sense="max",
+                stdev_init=0.1,
+            )
+            batch = pgpe_ask_trunk_delta(
+                jax.random.key(self._seed),
+                state,
+                popsize=self.shape.popsize,
+                rank=rank,
+                policy=self.policy,
+            )
+            jax.block_until_ready(batch.coeffs)
+            self._rank_batches[rank] = batch
+        return self._rank_batches[rank]
+
+    def default_config(self):
+        return {"rank": self.ranks[0], "trunk_block": 0}
+
+    def knob_group(self) -> KnobGroup:
+        return KnobGroup(
+            name=self.group,
+            knobs=(
+                # menu-only knobs: a refined off-grid rank would need a
+                # fresh population + compile per midpoint, and block sizes
+                # off the divisor menu violate the popsize % block contract
+                KnobSpec("rank", self.ranks, refine=False),
+                KnobSpec("trunk_block", self.trunk_blocks, refine=False),
+            ),
+        )
+
+    def run_once(self, config, key, *, warmup: bool = False):
+        from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+        result = run_vectorized_rollout(
+            self.env,
+            self.policy,
+            self._params_for(config["rank"]),
+            key,
+            self.stats,
+            eval_mode="budget",
+            trunk_block=int(config.get("trunk_block", 0)),
+            num_episodes=self.shape.num_episodes,
+            episode_length=self.shape.episode_length,
+            compute_dtype=self.shape.compute_dtype,
+        )
+        if warmup:
+            import jax
+
+            jax.block_until_ready(result.scores)
+        return result
+
+    def cost(self, config):
+        """Analytic cost of the candidate's trunk-delta budget program
+        (one AOT capture, outside every timed region)."""
+        import jax
+
+        from .programs import ProgramLedger, abstract_like
+        from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+        led = ProgramLedger()
+        record = led.capture(
+            self.program,
+            run_vectorized_rollout,
+            self.env,
+            self.policy,
+            abstract_like(self._params_for(config["rank"])),
+            jax.random.key(0),
+            self.stats,
+            shape=dict(self.shape.as_dict(), **config),
+            eval_mode="budget",
+            trunk_block=int(config.get("trunk_block", 0)),
+            num_episodes=self.shape.num_episodes,
+            episode_length=self.shape.episode_length,
+            compute_dtype=self.shape.compute_dtype,
+        )
+        return {
+            "peak_bytes": record.peak_bytes,
+            "flops": record.flops,
+            "compile_seconds": record.compile_seconds,
+        }
+
+    def baseline(self, trials: int = 3) -> Dict[str, Any]:
+        """Median steps/s of the DENSE budget contract at the same shape —
+        the policy group's speedup denominator is dense-vs-trunk-delta at
+        the same contract, not a contract A/B."""
+        if self._episodes_baseline is not None:
+            return self._episodes_baseline
+        from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+        def runner(key):
+            return run_vectorized_rollout(
+                self.env,
+                self.policy,
+                self.values,
+                key,
+                self.stats,
+                eval_mode="budget",
+                num_episodes=self.shape.num_episodes,
+                episode_length=self.shape.episode_length,
+                compute_dtype=self.shape.compute_dtype,
+            )
+
+        import jax
+
+        jax.block_until_ready(runner(self._next_key()).scores)  # warmup
+        samples, occupancies = [], []
+        for _ in range(max(1, trials)):
+            sps, telemetry, _ = self._timed_call(
+                "budget_dense", {"contract": "budget_dense"}, runner
+            )
+            samples.append(sps)
+            if telemetry is not None:
+                occupancies.append(telemetry.occupancy)
+        self._episodes_baseline = {
+            "steps_per_sec": _median(samples),
+            "occupancy": _median(occupancies) if occupancies else None,
+            "samples": samples,
+        }
+        return self._episodes_baseline
+
+    def tuned_config(self, config):
+        return {
+            "rank": int(config["rank"]),
+            "trunk_block": int(config.get("trunk_block", 0)),
+        }
+
+
 class HostPipelineHarness:
     """Tunes the HOST-path knobs: the pipelined scheduler's lane-block
     count and (for MuJoCo backends) the physics thread-pool width. These
@@ -1029,9 +1256,14 @@ def tune_group(
 
     ``min_occupancy="auto"`` takes the HARNESS's per-group floor
     (``default_min_occupancy``): 0.9 for refill, none for compact —
-    whose contract structurally runs ~0.5 — and the host pipeline."""
+    whose contract structurally runs ~0.5 — and the host pipeline.
+    Secondary-objective selection (``winner_tolerance`` /
+    ``winner_prefer`` — the policy group's highest-rank-within-band
+    rule) also comes from the harness."""
     if min_occupancy == "auto":
         min_occupancy = getattr(harness, "default_min_occupancy", None)
+    tolerance = getattr(harness, "winner_tolerance", None)
+    prefer = getattr(harness, "winner_prefer", None)
     led = ledger_out if ledger_out is not None else timings
     group = harness.knob_group()
     machine = machine_fingerprint()
@@ -1063,6 +1295,8 @@ def tune_group(
         survivor_frac=survivor_frac,
         max_rounds=max_rounds,
         min_occupancy=min_occupancy,
+        tolerance=tolerance,
+        prefer=prefer,
         refine=refine,
     )
 
@@ -1299,7 +1533,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--group",
         default="refill",
-        help="comma list of knob groups: refill, compact, host_pipeline",
+        help="comma list of knob groups: refill, compact, host_pipeline, "
+        "policy",
     )
     parser.add_argument("--cpu", action="store_true",
                         help="force the 8-virtual-device CPU backend")
@@ -1325,6 +1560,11 @@ def main(argv=None) -> int:
                         help="compact chunk-size grid (comma list)")
     parser.add_argument("--min-widths", default="128,256,512",
                         help="compact width-menu-floor grid (comma list)")
+    parser.add_argument("--ranks", default="4,16,64",
+                        help="policy-group trunk-delta rank grid (comma list)")
+    parser.add_argument("--trunk-blocks", default="0",
+                        help="policy-group lane-block grid (comma list; 0 = "
+                        "unblocked, others must divide the popsize)")
     parser.add_argument("--hbm-budget", type=float, default=None,
                         help="absolute peak-HBM prune budget in bytes")
     parser.add_argument("--hbm-budget-ratio", type=float, default=8.0,
@@ -1346,7 +1586,7 @@ def main(argv=None) -> int:
 
     use_cpu = _setup_backend(args.cpu)
     groups = [g.strip() for g in args.group.split(",") if g.strip()]
-    unknown = set(groups) - {"refill", "compact", "host_pipeline"}
+    unknown = set(groups) - {"refill", "compact", "host_pipeline", "policy"}
     if unknown:
         parser.error(f"unknown group(s): {sorted(unknown)}")
 
@@ -1370,6 +1610,13 @@ def main(argv=None) -> int:
                 shape,
                 chunks=[int(c) for c in args.chunks.split(",") if c],
                 min_widths=[int(w) for w in args.min_widths.split(",") if w],
+                seed=args.seed,
+            )
+        elif group_name == "policy":
+            harness = PolicyHarness(
+                shape,
+                ranks=[int(r) for r in args.ranks.split(",") if r],
+                trunk_blocks=[int(b) for b in args.trunk_blocks.split(",") if b != ""],
                 seed=args.seed,
             )
         else:

@@ -1,9 +1,11 @@
 """The registered program inventory of the stack's jitted entry points.
 
 One place declares *which* compiled programs constitute the framework —
-the four eval-contract rollout programs, the sharded evaluator, the
-gaussian functional ask/tell, the batched functional search, and the
-bench/multichip/GSPMD whole-generation steps — so the program ledger
+the four eval-contract rollout programs (plus their trunk-delta policy
+forms, ``docs/policies.md``), the sharded evaluator, the gaussian
+functional ask/tell, the batched functional search, and the
+bench/multichip/GSPMD whole-generation steps (dense and trunk-delta) —
+so the program ledger
 (:mod:`~evotorch_tpu.observability.programs`), the report CLI and the
 fast-tier perf-regression gate all see the same surface.
 
@@ -58,6 +60,7 @@ class GateConfig:
     hidden: Tuple[int, ...] = (8,)
     refill_width: int = 4
     chunk_size: int = 8
+    trunk_rank: int = 4
     batched_searches: int = 4
     batched_dim: int = 8
     batched_popsize: int = 8
@@ -142,6 +145,58 @@ def _batched_search_program(num_searches: int, dim: int, popsize: int):
         return jax.lax.scan(_generation, state, keys)
 
     return jax.jit(_run, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=8)
+def _trunk_delta_batch(policy, popsize: int, rank: int):
+    """One concrete trunk-delta population at the gate shape (cached: the
+    rollout captures only need its ShapeDtypeStruct skeleton, but the
+    skeleton must carry the REAL pytree structure — factors treedef
+    included — for the capture to lower the dispatched program)."""
+    import jax
+
+    from ..algorithms.functional import pgpe_ask_trunk_delta
+
+    state = _fresh_pgpe_state(policy.parameter_count)
+    return pgpe_ask_trunk_delta(
+        jax.random.key(0), state, popsize=popsize, rank=rank, policy=policy
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _trunk_generation_program(
+    env, policy, popsize: int, episode_length: int, rank: int
+):
+    """The trunk-delta analog of the bench generation: factored ask ->
+    budget rollout (shared-trunk + per-lane delta forward) -> factored
+    tell, one jitted program donating the optimizer state."""
+    import jax
+
+    from ..algorithms.functional import (
+        pgpe_ask_trunk_delta,
+        pgpe_tell_trunk_delta,
+    )
+    from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+    def _generation(state, key, stats):
+        k1, k2 = jax.random.split(key)
+        values = pgpe_ask_trunk_delta(
+            k1, state, popsize=popsize, rank=rank, policy=policy
+        )
+        result = run_vectorized_rollout(
+            env,
+            policy,
+            values,
+            k2,
+            stats,
+            num_episodes=1,
+            episode_length=episode_length,
+            eval_mode="budget",
+        )
+        new_state = pgpe_tell_trunk_delta(state, values, result.scores)
+        return new_state, result.total_steps, result.scores
+
+    return jax.jit(_generation, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=8)
@@ -377,6 +432,45 @@ def build_specs(cfg: Optional[GateConfig] = None) -> List[ProgramSpec]:
         rollout_capture("episodes_refill", refill_shape, refill_width=cfg.refill_width),
     )
 
+    trunk_shape = dict(base_shape, rank=cfg.trunk_rank)
+
+    def trunk_rollout_capture(mode, name, shape, **extra):
+        def _capture(led):
+            batch = _trunk_delta_batch(policy, cfg.popsize, cfg.trunk_rank)
+            return led.capture(
+                name,
+                run_vectorized_rollout,
+                env,
+                policy,
+                _abstract(batch),
+                jax.random.key(0),
+                stats,
+                shape=shape,
+                num_episodes=1,
+                episode_length=cfg.episode_length,
+                eval_mode=mode,
+                **extra,
+            )
+
+        return _capture
+
+    add(
+        "rollout.budget.trunk_delta",
+        trunk_shape,
+        trunk_rollout_capture("budget", "rollout.budget.trunk_delta", trunk_shape),
+    )
+    trunk_refill_shape = dict(trunk_shape, width=cfg.refill_width)
+    add(
+        "rollout.episodes_refill.trunk_delta",
+        trunk_refill_shape,
+        trunk_rollout_capture(
+            "episodes_refill",
+            "rollout.episodes_refill.trunk_delta",
+            trunk_refill_shape,
+            refill_width=cfg.refill_width,
+        ),
+    )
+
     compact_shape = dict(base_shape, chunk=cfg.chunk_size)
 
     def compact_capture(led):
@@ -480,6 +574,21 @@ def build_specs(cfg: Optional[GateConfig] = None) -> List[ProgramSpec]:
         )
 
     add("bench.generation", base_shape, bench_capture)
+
+    def trunk_bench_capture(led):
+        fn = _trunk_generation_program(
+            env, policy, cfg.popsize, cfg.episode_length, cfg.trunk_rank
+        )
+        return led.capture(
+            "bench.generation.trunk_delta",
+            fn,
+            _abstract(_fresh_pgpe_state(L)),
+            jax.random.key(0),
+            stats,
+            shape=trunk_shape,
+        )
+
+    add("bench.generation.trunk_delta", trunk_shape, trunk_bench_capture)
 
     def multichip_capture(led):
         fn = _multichip_generation_program(
@@ -597,6 +706,14 @@ def donated_programs(cfg: Optional[GateConfig] = None):
         (
             "bench.generation",
             _bench_generation_program(env, policy, cfg.popsize, cfg.episode_length),
+            (_fresh_pgpe_state(L), jax.random.key(0), stats),
+            (0,),
+        ),
+        (
+            "bench.generation.trunk_delta",
+            _trunk_generation_program(
+                env, policy, cfg.popsize, cfg.episode_length, cfg.trunk_rank
+            ),
             (_fresh_pgpe_state(L), jax.random.key(0), stats),
             (0,),
         ),

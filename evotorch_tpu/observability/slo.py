@@ -20,6 +20,11 @@ contract the multi-tenant eval service and island PBT need:
 ``min_progress``
     every group's (or one group's) env-step count must be >= ``threshold``
     — a starved tenant shows up here even when its occupancy is undefined.
+``min_model_efficiency``
+    the ``model_efficiency`` status key (the program ledger's achieved
+    fraction of nominal peak FLOPs — a BENCH_LEDGER=1 bench-line column)
+    must be >= ``threshold``. Skipped when the key is absent; per-contract
+    columns are checked by the bench CLI (``--min-model-efficiency``).
 
 The watchdog surfaces as searcher status keys (``slo_ok`` /
 ``slo_violations`` / ``slo_detail``) via ``VecNEProblem(slo=...)``, and as
@@ -58,6 +63,7 @@ RULE_KINDS = (
     "starvation_ceiling",
     "no_steady_compiles",
     "min_progress",
+    "min_model_efficiency",
 )
 
 
@@ -155,6 +161,16 @@ class SLOWatchdog:
             if int(compiles) > 0:
                 return f"steady_compiles={int(compiles)} (expected 0)"
             return False
+        if rule.kind == "min_model_efficiency":
+            efficiency = status.get("model_efficiency")
+            if efficiency is None:  # no ledger columns on this run — skip
+                return None
+            if float(efficiency) < rule.threshold:
+                return (
+                    f"model_efficiency={float(efficiency):.4g} < "
+                    f"{rule.threshold:g}"
+                )
+            return False
         if telemetry is None:
             return None
         groups = (
@@ -212,13 +228,21 @@ DEFAULT_BENCH_RULES: Tuple[Rule, ...] = (
 
 # ---------------------------------------------------------------- bench CLI
 def check_bench_line(
-    line: Dict[str, Any], *, occupancy_floor: float = 0.1
+    line: Dict[str, Any],
+    *,
+    occupancy_floor: float = 0.1,
+    min_model_efficiency: Optional[float] = None,
 ) -> SLOReport:
     """Apply the battery rules to one decoded bench.py JSON line.
 
     The bench line carries scalars, not a (G, K) matrix, so this reads the
     top-level ``occupancy`` / ``steady_compiles`` keys (plus per-mode
-    occupancies under ``modes``) directly.
+    occupancies under ``modes``) directly. With ``min_model_efficiency``
+    set, the program-ledger efficiency columns (``model_efficiency``,
+    top-level and per contract under ``modes`` — present when the line was
+    produced with BENCH_LEDGER=1) must each clear the floor; a line with
+    no ledger columns skips those checks (missing analysis degrades, it
+    doesn't fail).
     """
     violations = []
     checked = 0
@@ -232,16 +256,32 @@ def check_bench_line(
         checked += 1
         if float(occ) < occupancy_floor:
             violations.append(f"occupancy={float(occ):.3f} < {occupancy_floor:g}")
+    eff = line.get("model_efficiency")
+    if min_model_efficiency is not None and eff is not None:
+        checked += 1
+        if float(eff) < min_model_efficiency:
+            violations.append(
+                f"model_efficiency={float(eff):.4g} < {min_model_efficiency:g}"
+            )
     modes = line.get("modes") or {}
     for mode, rec in sorted(modes.items()):
-        mocc = rec.get("occupancy") if isinstance(rec, dict) else None
-        if mocc is None:
+        if not isinstance(rec, dict):
             continue
-        checked += 1
-        if float(mocc) < occupancy_floor:
-            violations.append(
-                f"modes.{mode}.occupancy={float(mocc):.3f} < {occupancy_floor:g}"
-            )
+        mocc = rec.get("occupancy")
+        if mocc is not None:
+            checked += 1
+            if float(mocc) < occupancy_floor:
+                violations.append(
+                    f"modes.{mode}.occupancy={float(mocc):.3f} < {occupancy_floor:g}"
+                )
+        meff = rec.get("model_efficiency")
+        if min_model_efficiency is not None and meff is not None:
+            checked += 1
+            if float(meff) < min_model_efficiency:
+                violations.append(
+                    f"modes.{mode}.model_efficiency={float(meff):.4g} < "
+                    f"{min_model_efficiency:g}"
+                )
     return SLOReport(ok=not violations, violations=tuple(violations), checked=checked)
 
 
@@ -280,6 +320,13 @@ def _main(argv=None) -> int:
         help="minimum acceptable occupancy, global and per mode (default 0.1)",
     )
     parser.add_argument(
+        "--min-model-efficiency",
+        type=float,
+        default=None,
+        help="minimum acceptable program-ledger model_efficiency, global "
+        "and per contract (default: unchecked; needs a BENCH_LEDGER=1 line)",
+    )
+    parser.add_argument(
         "--verdict-out",
         metavar="PATH",
         default=None,
@@ -288,7 +335,11 @@ def _main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     line = _last_json_line(args.check_bench)
-    report = check_bench_line(line, occupancy_floor=args.occupancy_floor)
+    report = check_bench_line(
+        line,
+        occupancy_floor=args.occupancy_floor,
+        min_model_efficiency=args.min_model_efficiency,
+    )
     verdict = "pass" if report.ok else "fail"
     if args.verdict_out:
         with open(args.verdict_out, "w", encoding="utf-8") as fh:

@@ -30,9 +30,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import default_mesh, mesh_label
+from .mesh import default_mesh, mesh_label, model_axis_size
 
-# compiled programs kept per (lowrank, popsize); matches the spirit of
+# compiled programs kept per (params kind, popsize); matches the spirit of
 # vecrl's _ENGINE_CACHE_SIZE bound
 _EVALUATOR_CACHE_SIZE = 64
 
@@ -91,9 +91,11 @@ def _pad_rows(values, padded_n: int):
     first row: always a VALID genome, so fitness functions undefined at
     synthetic points (log/div at the zero vector) and jax_debug_nans stay
     safe. Consumers mask the tail via ``num_valid`` or discard it."""
-    from ..tools.lowrank import LowRankParamsBatch
+    from ..tools.lowrank import is_factored
 
-    if isinstance(values, LowRankParamsBatch):
+    if is_factored(values):
+        # per-lane state is the coefficients alone; _replace is
+        # type-preserving, so trunk-delta batches keep their factors
         coeffs = values.coeffs
         pad = jnp.broadcast_to(
             coeffs[:1], (padded_n - coeffs.shape[0],) + coeffs.shape[1:]
@@ -107,10 +109,33 @@ def _constrain_population(values, mesh: Mesh):
     """Pin a (dense or factored) population to the mesh's population layout
     inside a jitted program. Low-rank batches shard their per-lane
     coefficients and replicate the shared center/basis (the factored analog
-    of ``vecrl._params_shard_spec``)."""
-    from ..tools.lowrank import LowRankParamsBatch
+    of ``vecrl._params_shard_spec``). Trunk-delta batches additionally pin
+    their L-sized trunk arrays (flat center + materialized effective basis)
+    to the ``model`` axis when the mesh has one — STORAGE sharding (ZeRO
+    style): XLA all-gathers the trunk at its use sites, which is
+    value-exact, so scores stay bit-identical to the unsharded program
+    while the dominant HBM term divides over the model axis
+    (``docs/sharding.md``)."""
+    from ..tools.lowrank import LowRankParamsBatch, TrunkDeltaParamsBatch
 
     spec = population_spec(mesh)
+    if isinstance(values, TrunkDeltaParamsBatch):
+        rep = NamedSharding(mesh, P())
+        trunk = (
+            NamedSharding(mesh, P(("model",)))
+            if model_axis_size(mesh) > 1
+            else rep
+        )
+        return TrunkDeltaParamsBatch(
+            center=jax.lax.with_sharding_constraint(values.center, trunk),
+            basis=jax.lax.with_sharding_constraint(values.basis, trunk),
+            coeffs=jax.lax.with_sharding_constraint(
+                values.coeffs, NamedSharding(mesh, spec)
+            ),
+            factors=jax.tree_util.tree_map(
+                lambda f: jax.lax.with_sharding_constraint(f, rep), values.factors
+            ),
+        )
     if isinstance(values, LowRankParamsBatch):
         rep = NamedSharding(mesh, P())
         return LowRankParamsBatch(
@@ -186,6 +211,15 @@ def _shard_map_evaluator(fitness_func, *, mesh, axis_name):
         return jax.tree_util.tree_map(lambda r: r[:n], result)
 
     return evaluator
+
+
+def _normalize_kind(kind) -> str:
+    """Accept the historical boolean ``lowrank`` flag on the
+    ``program_builder`` surface and map it onto the kind tags
+    (``vecrl._params_kind``): ``False`` -> dense, ``True`` -> lowrank."""
+    if isinstance(kind, bool):
+        return "lowrank" if kind else "dense"
+    return str(kind)
 
 
 _RESERVED_ROLLOUT_KWARGS = {"lane_ids", "stats_sync_axis", "seed_stride", "num_valid"}
@@ -287,9 +321,11 @@ def make_sharded_rollout_evaluator(
     branch taken: override / cache / fallback.
 
     Accepts dense ``(N, L)`` populations and factored
-    ``LowRankParamsBatch``es (coefficients shard; center/basis replicate).
-    Returns ``evaluator(values, key, stats) -> (RolloutResult,
-    per_shard_steps)``.
+    ``LowRankParamsBatch``es (coefficients shard; center/basis replicate) or
+    ``TrunkDeltaParamsBatch``es (coefficients shard over the population
+    layout; the L-sized trunk arrays storage-shard over the ``model`` axis
+    when the mesh has one — see ``_constrain_population``). Returns
+    ``evaluator(values, key, stats) -> (RolloutResult, per_shard_steps)``.
     """
     _check_reserved(rollout_kwargs, "make_sharded_rollout_evaluator")
     if mesh is None:
@@ -306,16 +342,16 @@ def make_sharded_rollout_evaluator(
 
     # imported lazily: parallel.* must stay importable before neuroevolution
     from ..neuroevolution.net.vecrl import (
+        _params_kind,
         _params_popsize,
         run_vectorized_rollout,
         RolloutResult,
     )
-    from ..tools.lowrank import LowRankParamsBatch
 
     n_grid = _mesh_grid_size(mesh)
     refill_mode = rollout_kwargs.get("eval_mode") == "episodes_refill"
 
-    def build(lowrank: bool, popsize: int):
+    def build(kind: str, popsize: int):
         local_kwargs = dict(rollout_kwargs)
         source = None
         if refill_mode:
@@ -376,9 +412,8 @@ def make_sharded_rollout_evaluator(
     build = functools.lru_cache(maxsize=_EVALUATOR_CACHE_SIZE)(build)
 
     def evaluator(values, key, stats):
-        lowrank = isinstance(values, LowRankParamsBatch)
         popsize = _params_popsize(values)
-        fn, source = build(lowrank, popsize)
+        fn, source = build(_params_kind(values), popsize)
         evaluator.tuned_config_source = source
         scores, merged, steps, episodes, per_shard, telemetry = fn(values, key, stats)
         result = RolloutResult(
@@ -390,10 +425,13 @@ def make_sharded_rollout_evaluator(
         )
         return result, per_shard
 
-    # the jitted (lowrank, popsize) -> program factory, exposed so the
-    # program ledger can AOT-lower the exact executable the evaluator
-    # dispatches (observability/inventory.py)
-    evaluator.program_builder = lambda lowrank, popsize: build(lowrank, popsize)[0]
+    # the jitted (kind, popsize) -> program factory, exposed so the program
+    # ledger can AOT-lower the exact executable the evaluator dispatches
+    # (observability/inventory.py); accepts the historical boolean lowrank
+    # flag or a kind tag ("dense"/"lowrank"/"trunk_delta")
+    evaluator.program_builder = lambda kind, popsize: build(
+        _normalize_kind(kind), popsize
+    )[0]
     # provenance of the LAST dispatched popsize's refill knobs ("override" /
     # "cache" / "fallback"; None before the first refill-mode dispatch)
     evaluator.tuned_config_source = None
@@ -412,13 +450,13 @@ def _shard_map_rollout_evaluator(
     """The pre-GSPMD explicit shard_map path (compat knob; see
     ``make_sharded_rollout_evaluator``)."""
     from ..neuroevolution.net.vecrl import (
+        _params_kind,
         _params_popsize,
         _params_shard_spec,
         global_lane_ids,
         run_vectorized_rollout,
         RolloutResult,
     )
-    from ..tools.lowrank import LowRankParamsBatch
 
     refill_mode = rollout_kwargs.get("eval_mode") == "episodes_refill"
     if refill_mode and rollout_kwargs.get("refill_width") is not None:
@@ -440,7 +478,7 @@ def _shard_map_rollout_evaluator(
     if collect_groups:
         groups_global = jnp.asarray(groups_global, dtype=jnp.int32)
 
-    def build(lowrank: bool, popsize: int):
+    def build(kind: str, popsize: int):
         # tuned-config cache: cache widths are GLOBAL, divided per shard with
         # the convenience-knob flooring (only an explicit width gets the
         # strict divisibility check above)
@@ -496,7 +534,7 @@ def _shard_map_rollout_evaluator(
                 telemetry,
             )
 
-        values_spec = _params_shard_spec(lowrank, axis_name)
+        values_spec = _params_shard_spec(kind, axis_name)
         in_specs = (values_spec, P(), P())
         if collect_groups:
             in_specs = in_specs + (P(axis_name),)
@@ -514,9 +552,8 @@ def _shard_map_rollout_evaluator(
     build = functools.lru_cache(maxsize=_EVALUATOR_CACHE_SIZE)(build)
 
     def evaluator(values, key, stats):
-        lowrank = isinstance(values, LowRankParamsBatch)
         popsize = _params_popsize(values)
-        fn, source = build(lowrank, popsize)
+        fn, source = build(_params_kind(values), popsize)
         evaluator.tuned_config_source = source
         if collect_groups:
             scores, merged, steps, episodes, per_shard, telemetry = fn(
@@ -535,7 +572,9 @@ def _shard_map_rollout_evaluator(
         )
         return result, per_shard
 
-    evaluator.program_builder = lambda lowrank, popsize: build(lowrank, popsize)[0]
+    evaluator.program_builder = lambda kind, popsize: build(
+        _normalize_kind(kind), popsize
+    )[0]
     evaluator.tuned_config_source = None
     return evaluator
 
@@ -560,10 +599,11 @@ def make_generation_step(
     ``docs/observability.md``).
 
     ``ask(key, state) -> values`` samples the ``(popsize, L)`` population
-    (dense or ``LowRankParamsBatch``); ``tell(state, values, scores) ->
-    state`` applies the update. Both run INSIDE the program — the population
-    is born on its shards, evaluated in place, and consumed by the update
-    without ever leaving the device grid.
+    (dense, ``LowRankParamsBatch``, or ``TrunkDeltaParamsBatch`` — e.g.
+    ``pgpe_ask_trunk_delta``); ``tell(state, values, scores) -> state``
+    applies the update. Both run INSIDE the program — the population is born
+    on its shards, evaluated in place, and consumed by the update without
+    ever leaving the device grid.
 
     Returns ``generation(state, key, stats) -> (state, scores, stats,
     total_steps, telemetry)``. With ``donate_state=True`` (default) the

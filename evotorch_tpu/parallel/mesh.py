@@ -22,6 +22,7 @@ __all__ = [
     "device_count",
     "make_mesh",
     "mesh_label",
+    "model_axis_size",
     "parse_mesh_shape",
 ]
 
@@ -79,6 +80,16 @@ def mesh_label(mesh: Optional[Mesh]) -> str:
     if n_hosts > 1:
         label = f"hosts{n_hosts}.{label}"
     return label
+
+
+def model_axis_size(mesh: Optional[Mesh]) -> int:
+    """Size of the mesh's ``model`` axis, 1 when absent (or no mesh): the
+    storage-sharding divisor for a trunk-delta population's L-sized trunk
+    arrays (``parallel.evaluate._constrain_population``;
+    docs/policies.md)."""
+    if mesh is None or "model" not in mesh.axis_names:
+        return 1
+    return int(mesh.shape["model"])
 
 
 def parse_mesh_shape(spec) -> dict:

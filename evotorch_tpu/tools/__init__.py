@@ -7,7 +7,7 @@ from . import cloning, constraints, hook, immutable, lowrank, misc, objectarray,
 from .cloning import Clonable, ReadOnlyClonable, Serializable, deep_clone
 from .constraints import log_barrier, penalty, violation
 from .hook import Hook
-from .lowrank import LowRankParamsBatch
+from .lowrank import LowRankParamsBatch, TrunkDeltaParamsBatch, is_factored
 from .immutable import (
     ImmutableContainer,
     ImmutableDict,
@@ -50,6 +50,8 @@ from .tensormaker import TensorMakerMixin
 
 __all__ = [
     "LowRankParamsBatch",
+    "TrunkDeltaParamsBatch",
+    "is_factored",
     "Clonable",
     "ReadOnlyClonable",
     "Serializable",

@@ -20,11 +20,18 @@ TPU-first framework feature (VERDICT r2 #2).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax.numpy as jnp
 
-__all__ = ["LowRankParamsBatch", "basis_capture", "dense_values"]
+__all__ = [
+    "FACTORED_BATCH_TYPES",
+    "LowRankParamsBatch",
+    "TrunkDeltaParamsBatch",
+    "basis_capture",
+    "dense_values",
+    "is_factored",
+]
 
 
 class LowRankParamsBatch(NamedTuple):
@@ -64,6 +71,62 @@ class LowRankParamsBatch(NamedTuple):
         return self.center + coeff_rows @ self.basis.T
 
 
+class TrunkDeltaParamsBatch(NamedTuple):
+    """A population expressed as ``theta_i = center + basis @ coeffs[i]``
+    where every basis column is STRUCTURED: per 2-D weight block the column
+    is ``vec(b_m a_m^T)`` (rank-1 over the block), so the policy forward
+    needs only the shared-trunk matmul ``x @ W_c^T`` plus two thin shared
+    GEMMs ``((x @ A) * z_i) @ B^T`` per layer — the MXU-efficient
+    shared-trunk + per-lane delta form (docs/policies.md).
+
+    ``basis`` is the MATERIALIZED effective basis (sigma folded), built from
+    ``factors`` at sample time — gradients, the subspace-exhaustion
+    guardrail, ``materialize`` and concatenation all reuse the
+    :class:`LowRankParamsBatch` algebra through it, while the rollout
+    forward reads ``factors`` (``neuroevolution/net/lowrank.py``'s trunk-
+    delta path). The two views agree by construction; build batches through
+    the samplers, not by hand.
+    """
+
+    center: jnp.ndarray  # (L,)
+    basis: jnp.ndarray  # (L, k) materialized effective basis
+    coeffs: jnp.ndarray  # (N, k)
+    factors: Any  # per-layer factor tree (net/lowrank.py's _Factor nodes)
+
+    @property
+    def popsize(self) -> int:
+        return self.coeffs.shape[0]
+
+    @property
+    def rank(self) -> int:
+        return self.basis.shape[-1]
+
+    def take(self, idx) -> "TrunkDeltaParamsBatch":
+        """Gather lanes; center/basis/factors are shared and ride along."""
+        return self._replace(coeffs=self.coeffs[idx])
+
+    def materialize(self) -> jnp.ndarray:
+        """The dense ``(N, L)`` population (correctness fallback only)."""
+        return self.center + self.coeffs @ self.basis.T
+
+    def materialize_rows(self, coeff_rows: jnp.ndarray) -> jnp.ndarray:
+        """Densify specific coefficient rows ``(K, k)`` -> ``(K, L)``."""
+        return self.center + coeff_rows @ self.basis.T
+
+
+#: every factored population representation: ``theta_i = center +
+#: basis @ coeffs[i]`` with per-lane state living ONLY in ``coeffs``.
+#: Code that relies on exactly that algebra (gradients, compaction,
+#: padding, dense boundaries) should test ``is_factored`` rather than
+#: pinning one concrete class.
+FACTORED_BATCH_TYPES = (LowRankParamsBatch, TrunkDeltaParamsBatch)
+
+
+def is_factored(values) -> bool:
+    """True for any factored population batch (low-rank or trunk-delta)."""
+    return isinstance(values, FACTORED_BATCH_TYPES)
+
+
 def basis_capture(basis: jnp.ndarray, vector: jnp.ndarray) -> jnp.ndarray:
     """Fraction of ``vector``'s norm captured by ``span(basis)``:
     ``||P_B v|| / ||v||`` in ``[0, 1]`` (returns 1.0 for a zero vector).
@@ -96,6 +159,6 @@ def dense_values(values):
     population into its ``(N, L)`` matrix; pass anything else through.
     Evaluators that only understand dense parameter vectors (plain fitness
     functions, host pools, per-network evals) call this at their entry."""
-    if isinstance(values, LowRankParamsBatch):
+    if is_factored(values):
         return values.materialize()
     return values

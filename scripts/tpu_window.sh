@@ -65,13 +65,25 @@ run bench_bf16 1800 env BENCH_BF16=1 python bench.py
 #     occupancy floor on every contract; docs/observability.md "Per-group
 #     telemetry & SLOs"). Writes the one-word pass/fail verdict file that
 #     tpu_watch.sh attaches to its battery_exited JSONL event.
+# --min-model-efficiency is a LOOSE sanity floor (an order-of-magnitude
+# collapse of the MFU column, not a tight target — the flagship 64x64
+# policy is inherently low-MFU; docs/policies.md has the wide-policy story)
 run slo_check 300 python -m evotorch_tpu.observability.slo \
-  --check-bench "$OUT/bench_f32.log" --verdict-out "$OUT/slo_verdict.txt"
+  --check-bench "$OUT/bench_f32.log" --min-model-efficiency 1e-5 \
+  --verdict-out "$OUT/slo_verdict.txt"
 
 # 2. the MXU claim: wide policy dense vs low-rank (budget contract isolates
 #    the policy cost; episodes_compact shows the combined effect)
 run wide_dense 1800 env BENCH_HIDDEN=256,256 BENCH_BF16=1 python bench.py
 run wide_lowrank 1800 env BENCH_HIDDEN=256,256 BENCH_BF16=1 BENCH_LOWRANK=32 python bench.py
+
+# 2b. the shared-trunk + per-lane delta form at the wide shape (ISSUE 16):
+#     BENCH_TRUNK_DELTA=1 measures all four trunk-delta contracts PLUS the
+#     in-process interleaved dense-vs-trunk-delta A/B (median-of-3 samples,
+#     trunk_delta_speedup on the JSON line) — the real-chip counterpart of
+#     the CPU acceptance measurement (docs/policies.md)
+run bigpolicy_bench 2400 env BENCH_HIDDEN=256,256 BENCH_BF16=1 \
+  BENCH_TRUNK_DELTA=1 python bench.py
 
 # 3. fused-kernel micro-bench (justifies/revokes the opt-in flags)
 run bench_ops 1800 python bench_ops.py
@@ -86,6 +98,14 @@ run bench_ops 1800 python bench_ops.py
 #     sweep as the compact knob group)
 run autotune 2400 env BENCH_BF16=1 python -m evotorch_tpu.observability.autotune \
   --group refill,compact --timings-out "$OUT/autotune_timings.json"
+
+# 3c. policy-form autotune at the WIDE shape: search trunk-delta rank x lane
+#     blocking where the trunk GEMM actually dominates (256x256), persisting
+#     the winner under the full workload-identity key the wide bench steps
+#     consult (docs/policies.md; docs/observability.md "The autotuner")
+run autotune_policy 2400 env BENCH_HIDDEN=256,256 BENCH_BF16=1 \
+  python -m evotorch_tpu.observability.autotune \
+  --group policy --timings-out "$OUT/autotune_policy_timings.json"
 
 # 4. sharded bench on the single real chip (mesh of 1; exercise the path)
 run bench_multichip 1800 python bench_multichip.py
