@@ -37,6 +37,14 @@ def setup_backend() -> bool:
 
     if use_cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # the subprocess probe above can race a tunnel that dies between the
+        # probe and THIS process's first backend use — which would then hang
+        # forever. The watchdog turns that hang into an actionable error
+        # (EVOTORCH_DEVICE_TIMEOUT deadline; docs/resilience.md).
+        from evotorch_tpu.resilience import probe_devices
+
+        probe_devices()
     return use_cpu
 
 

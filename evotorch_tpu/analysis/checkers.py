@@ -26,6 +26,12 @@ checker        what it catches
                callables with no ``block_until_ready()`` in the region —
                such timings measure async dispatch, not the computation
                (unsynced-timing bugs)
+``swallow``    broad exception handlers (bare ``except``, ``except
+               Exception``/``BaseException``) that neither log/report nor
+               re-raise — silent degradation: the failure its author
+               shrugged off becomes invisible at every later debugging
+               session. Intentional swallows carry
+               ``# graftlint: allow(swallow): reason``
 =============  ==============================================================
 
 All checkers are pure-AST (no imports executed). Each returns
@@ -1019,6 +1025,72 @@ def check_timing(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# (h) silently swallowed exceptions
+# ---------------------------------------------------------------------------
+
+
+#: broad exception classes whose handlers must not be silent
+_SWALLOW_BROAD = {"Exception", "BaseException"}
+
+#: a call whose final attribute is one of these counts as reporting the
+#: failure: stdlib logging/warnings/print, traceback capture, and the
+#: registry counters (a counted degradation is observable, not silent)
+_SWALLOW_REPORTERS = {
+    "print", "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "log", "format_exc", "print_exc", "increment", "accumulate",
+    "observe_max", "instant", "fail", "skip",
+}
+
+
+def _swallow_broad_handler(mod: ModuleInfo, handler: ast.ExceptHandler) -> Optional[str]:
+    """The label to report for a broad handler, or None for a narrow one."""
+    t = handler.type
+    if t is None:
+        return "bare except"
+    types = list(t.elts) if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        name = mod.canon(node) or dotted_name(node) or ""
+        if name.rpartition(".")[2] in _SWALLOW_BROAD:
+            return f"except {name.rpartition('.')[2]}"
+    return None
+
+
+def _swallow_handler_reports(mod: ModuleInfo, handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = mod.canon(node.func) or dotted_name(node.func) or ""
+                if name.rpartition(".")[2] in _SWALLOW_REPORTERS:
+                    return True
+                if "logg" in name.lower():  # logger.*/logging.* helpers
+                    return True
+    return False
+
+
+def check_swallow(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        label = _swallow_broad_handler(mod, node)
+        if label is None or _swallow_handler_reports(mod, node):
+            continue
+        findings.append(
+            mod.finding(
+                "swallow",
+                node,
+                f"{label} neither logs, counts, nor re-raises — the failure "
+                "degrades silently; report it, re-raise, or annotate "
+                "`# graftlint: allow(swallow): reason`",
+                f"swallow:{label}",
+            )
+        )
+    return findings
+
+
 CHECKERS = {
     "prng": check_prng,
     "retrace": check_retrace,
@@ -1027,4 +1099,5 @@ CHECKERS = {
     "axis-name": check_axis_names,
     "dtype": check_dtype,
     "timing": check_timing,
+    "swallow": check_swallow,
 }

@@ -56,12 +56,30 @@ def load_state(path: str, template: Any) -> Any:
 
 
 def save_searcher(path: str, searcher) -> str:
-    """Pickle a whole OO searcher (reference-style whole-object checkpoint)."""
-    with open(path, "wb") as f:
+    """Pickle a whole OO searcher (reference-style whole-object checkpoint).
+
+    Crash-safe: the pickle goes to a sibling tmp file, is fsync'd, and is
+    renamed into place — a crash mid-write leaves either the previous
+    checkpoint or none, never a truncated pickle. (Durable multi-bundle
+    checkpointing with retention and corruption fallback is
+    ``resilience.RunCheckpointer``, which builds on this primitive.)
+    """
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
         pickle.dump(searcher, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
     return path
 
 
 def load_searcher(path: str):
-    with open(path, "rb") as f:
-        return pickle.load(f)
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except (pickle.UnpicklingError, EOFError, AttributeError) as exc:
+        raise RuntimeError(
+            f"checkpoint {path!r} is corrupt or truncated ({exc}); it likely "
+            "predates the crash-safe writer — delete it, or resume from a "
+            "resilience.RunCheckpointer bundle directory instead"
+        ) from exc

@@ -191,7 +191,7 @@ class PicklingLogger(Logger):
             ):
                 try:
                     payload["policy"] = problem.to_policy(payload[policy_source])
-                except Exception:
+                except Exception:  # graftlint: allow(swallow): policy attachment is optional decoration of the pickle payload
                     pass
         fname = os.path.join(
             self._directory,
@@ -215,7 +215,7 @@ def _picklable(x: Any) -> Any:
 
         if isinstance(x, jax.Array):
             return np.asarray(x)
-    except Exception:
+    except Exception:  # graftlint: allow(swallow): probe: without a working jax the raw object is the right fallback
         pass
     return x
 

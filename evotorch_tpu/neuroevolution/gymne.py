@@ -372,7 +372,7 @@ class GymNE(NEProblem):
             self._vec_env = None
             try:
                 vec_env.close()
-            except Exception:
+            except Exception:  # graftlint: allow(swallow): best-effort cleanup while already re-raising the eval failure
                 pass
             raise
         self._interaction_count += result["interactions"]

@@ -357,7 +357,7 @@ class _PhysicsWorker:
                 with span("physics", "pipeline", block=label):
                     result = self._vec_env.step(actions, active=active)
                 self._results.put(("ok", result))
-            except BaseException as exc:  # surfaced on the main thread
+            except BaseException as exc:  # surfaced on the main thread  # graftlint: allow(swallow): shipped to the main thread via the result queue and re-raised there
                 self._results.put(("error", exc))
 
     def submit(self, actions, active, label=None):

@@ -210,6 +210,12 @@ class Tanh(Apply):
     def __init__(self):
         super().__init__(jnp.tanh)
 
+    def __reduce__(self):
+        # the stored jax ufunc object does not pickle by qualified name on
+        # this jax; rebuilding from the (argless) constructor does — keeps
+        # whole-searcher checkpoints (resilience.RunCheckpointer) working
+        return (Tanh, ())
+
     def __repr__(self):
         return "Tanh()"
 
@@ -217,6 +223,9 @@ class Tanh(Apply):
 class ReLU(Apply):
     def __init__(self):
         super().__init__(jax.nn.relu)
+
+    def __reduce__(self):
+        return (ReLU, ())
 
     def __repr__(self):
         return "ReLU()"
@@ -226,6 +235,9 @@ class Sigmoid(Apply):
     def __init__(self):
         super().__init__(jax.nn.sigmoid)
 
+    def __reduce__(self):
+        return (Sigmoid, ())
+
     def __repr__(self):
         return "Sigmoid()"
 
@@ -233,6 +245,9 @@ class Sigmoid(Apply):
 class Softmax(Apply):
     def __init__(self, axis: int = -1):
         super().__init__(jax.nn.softmax, axis=axis)
+
+    def __reduce__(self):
+        return (Softmax, (self._kwargs.get("axis", -1),))
 
     def __repr__(self):
         return "Softmax()"

@@ -77,7 +77,7 @@ def lowrank_supported(module: Module) -> bool:
 def _is_parameterless(module: Module) -> bool:
     try:
         params = module.init(jax.random.key(0))
-    except Exception:
+    except Exception:  # graftlint: allow(swallow): probe: a module that cannot init is simply not parameterless
         return False
     return len(jax.tree_util.tree_leaves(params)) == 0 and not module.is_stateful
 

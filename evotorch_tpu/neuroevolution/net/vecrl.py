@@ -213,7 +213,15 @@ class Policy:
 
 
 # telemetry matrix column indices (devicemetrics._SLOTS order)
-_COL_ENV_STEPS, _COL_EPISODES, _COL_CAPACITY, _COL_LANE_WIDTH, _COL_REFILL, _COL_WAIT = range(6)
+(
+    _COL_ENV_STEPS,
+    _COL_EPISODES,
+    _COL_CAPACITY,
+    _COL_LANE_WIDTH,
+    _COL_REFILL,
+    _COL_WAIT,
+    _COL_NONFINITE,
+) = range(7)
 
 
 def _empty_lane_groups():
@@ -262,6 +270,52 @@ def _fold_lane_counts(
         per_lane = per_lane * mask.astype(jnp.int32)[:, None]
     return group_counts.at[:, :_COL_LANE_WIDTH].add(
         jax.ops.segment_sum(per_lane, lane_groups, num_segments=num_groups)
+    )
+
+
+def _quarantine_nonfinite(scores, *, valid_mask=None, penalty=None, sync_axis=None):
+    """Non-finite score quarantine (docs/resilience.md): replace NaN/Inf
+    entries of a final per-solution score vector with the WORST finite score
+    in the batch (or a fixed ``penalty``) and return the replacement mask.
+
+    Runs once at the very end of an engine, on the ``(N,)`` mean scores —
+    one ``isfinite`` plus a select, so the quarantined program is the
+    unquarantined one plus a handful of elementwise ops. ``valid_mask``
+    excludes padding rows from the worst-finite reduction (their synthetic
+    scores are not evidence) and from the returned COUNT mask — but their
+    values are still scrubbed finite, so no NaN survives in the full-width
+    vector whatever a caller reduces over before slicing. ``sync_axis``
+    (shard_map callers) pmins the worst-finite value over the mesh so
+    sharded replacement scores stay bit-identical to unsharded; the counts
+    are additive and psum with the rest of the telemetry.
+    """
+    finite = jnp.isfinite(scores)
+    bad = ~finite  # replacement mask: every non-finite entry is scrubbed
+    consider = finite
+    counted = bad
+    if valid_mask is not None:
+        counted = bad & valid_mask
+        consider = consider & valid_mask
+    if penalty is not None:
+        repl = jnp.asarray(penalty, dtype=scores.dtype)
+    else:
+        big = jnp.asarray(jnp.finfo(scores.dtype).max, dtype=scores.dtype)
+        worst = jnp.min(jnp.where(consider, scores, big))
+        if sync_axis is not None:
+            worst = jax.lax.pmin(worst, sync_axis)
+        # an all-non-finite (or all-padding) batch leaves no worst finite
+        # score to charge: quarantine to 0.0 rather than float-max
+        repl = jnp.where(worst >= big, jnp.zeros((), scores.dtype), worst)
+    return jnp.where(bad, repl, scores), counted
+
+
+def _nonfinite_group_counts(group_counts, bad, groups, num_groups: int):
+    """Fold a quarantine mask into the ``nonfinite`` telemetry column, one
+    count per quarantined SOLUTION, charged to the solution's group."""
+    return group_counts.at[:, _COL_NONFINITE].add(
+        jax.ops.segment_sum(
+            bad.astype(jnp.int32), groups, num_segments=int(num_groups)
+        )
     )
 
 
@@ -703,6 +757,9 @@ def _make_step(
         "num_valid",
         "num_groups",
         "trunk_block",
+        "nonfinite_quarantine",
+        "nonfinite_penalty",
+        "nonfinite_sync_axis",
     ),
 )
 def run_vectorized_rollout(
@@ -730,8 +787,22 @@ def run_vectorized_rollout(
     groups=None,
     num_groups: int = 1,
     trunk_block: int = 0,
+    nonfinite_quarantine: bool = False,
+    nonfinite_penalty: Optional[float] = None,
+    nonfinite_sync_axis: Optional[str] = None,
 ) -> RolloutResult:
     """Evaluate ``N`` policies on ``N`` environments, fully on-device.
+
+    ``nonfinite_quarantine`` (default off at this primitive layer; ``VecNE``
+    turns it on) replaces non-finite final scores with the batch's worst
+    FINITE score — or the fixed ``nonfinite_penalty`` when given — inside
+    the same jitted program, and counts the quarantined solutions in the
+    telemetry's ``nonfinite`` slot (per group at G > 1), so one diverged
+    rollout cannot NaN-poison ranking (docs/resilience.md).
+    ``nonfinite_sync_axis`` is for explicit shard_map callers: the
+    worst-finite reduction pmins over that axis so the sharded replacement
+    equals the unsharded one (the GSPMD path needs nothing — its reduction
+    is global by construction).
 
     ``trunk_block`` (trunk-delta populations only): static lane-block size
     of the shared-trunk forward — the population batch is chunked into
@@ -874,6 +945,9 @@ def run_vectorized_rollout(
             groups=groups,
             num_groups=num_groups,
             trunk_block=trunk_block,
+            nonfinite_quarantine=nonfinite_quarantine,
+            nonfinite_penalty=nonfinite_penalty,
+            nonfinite_sync_axis=nonfinite_sync_axis,
         )
     hard_cap = max_t * int(num_episodes) + 1
     budget_mode = eval_mode == "budget"
@@ -941,6 +1015,18 @@ def run_vectorized_rollout(
 
         final = jax.lax.while_loop(cond, lambda c: step(params_batch, ctx, c), carry)
         mean_scores = final.scores / jnp.maximum(final.episodes_done, 1)
+    nf_bad = None
+    if nonfinite_quarantine:
+        mean_scores, nf_bad = _quarantine_nonfinite(
+            mean_scores,
+            valid_mask=(
+                None
+                if num_valid is None
+                else jnp.arange(n_total, dtype=jnp.int32) < num_valid
+            ),
+            penalty=nonfinite_penalty,
+            sync_axis=nonfinite_sync_axis,
+        )
     total_episodes = jnp.sum(final.episodes_done)
     if num_valid is not None and not budget_mode:
         # padding lanes were initialized as already-finished; subtract their
@@ -954,16 +1040,21 @@ def run_vectorized_rollout(
         # the per-group counter block IS the telemetry (no histograms in the
         # non-refill engines: nothing queues, nothing waits); the per-lane
         # accumulators fold here, once, after the loop
-        eval_telemetry = pack_group_telemetry(
-            _fold_lane_counts(
-                final.group_counts,
-                final.lane_steps,
-                final.lane_episodes,
-                final.lane_groups,
-                final.t_global,
-                num_groups,
-            )
+        group_counts = _fold_lane_counts(
+            final.group_counts,
+            final.lane_steps,
+            final.lane_episodes,
+            final.lane_groups,
+            final.t_global,
+            num_groups,
         )
+        if nf_bad is not None:
+            # lanes == solutions in these engines, so the per-lane group ids
+            # charge the quarantine counts to the right rows
+            group_counts = _nonfinite_group_counts(
+                group_counts, nf_bad, final.lane_groups, num_groups
+            )
+        eval_telemetry = pack_group_telemetry(group_counts)
     else:
         eval_telemetry = pack_group_telemetry(
             pack_eval_telemetry(
@@ -971,6 +1062,9 @@ def run_vectorized_rollout(
                 episodes=total_episodes,
                 capacity=final.capacity,
                 lane_width=final.active.shape[0],
+                nonfinite=(
+                    0 if nf_bad is None else jnp.sum(nf_bad.astype(jnp.int32))
+                ),
             )[None]
         )
     return RolloutResult(
@@ -1175,6 +1269,9 @@ def _run_refill(
     groups=None,
     num_groups=1,
     trunk_block=0,
+    nonfinite_quarantine=False,
+    nonfinite_penalty=None,
+    nonfinite_sync_axis=None,
 ) -> RolloutResult:
     """The ``episodes_refill`` evaluation: exact ``episodes`` semantics (each
     solution is scored by the mean return of exactly ``num_episodes``
@@ -1514,31 +1611,53 @@ def _run_refill(
 
     final = jax.lax.while_loop(cond, step, carry)
     mean_scores = final.scores_buf / jnp.maximum(final.eps_buf, 1).astype(jnp.float32)
+    nf_bad = None
+    if nonfinite_quarantine:
+        mean_scores, nf_bad = _quarantine_nonfinite(
+            mean_scores,
+            valid_mask=(
+                None
+                if num_valid is None
+                else jnp.arange(n, dtype=jnp.int32) < nv
+            ),
+            penalty=nonfinite_penalty,
+            sync_axis=nonfinite_sync_axis,
+        )
     total_episodes = jnp.sum(final.eps_buf)
+    if not telemetry:
+        eval_telemetry = None
+    elif collect_groups:
+        group_counts = final.group_counts
+        if nf_bad is not None:
+            # scores_buf is per SOLUTION here: charge each quarantined
+            # solution's group directly off the per-solution id array
+            group_counts = _nonfinite_group_counts(
+                group_counts, nf_bad, groups_arr, num_groups
+            )
+        eval_telemetry = pack_group_telemetry(group_counts, final.hist)
+    else:
+        eval_telemetry = pack_group_telemetry(
+            pack_eval_telemetry(
+                env_steps=final.total_steps,
+                episodes=total_episodes,
+                capacity=final.capacity,
+                lane_width=width,
+                # items 0..width-1 seeded the lanes; everything past
+                # that entered through the refill gather
+                refill_events=final.next_item - jnp.int32(width),
+                queue_wait=final.wait_sum,
+                nonfinite=(
+                    0 if nf_bad is None else jnp.sum(nf_bad.astype(jnp.int32))
+                ),
+            )[None],
+            final.hist,
+        )
     return RolloutResult(
         scores=mean_scores,
         stats=final.stats,
         total_steps=final.total_steps,
         total_episodes=total_episodes,
-        telemetry=(
-            None
-            if not telemetry
-            else pack_group_telemetry(final.group_counts, final.hist)
-            if collect_groups
-            else pack_group_telemetry(
-                pack_eval_telemetry(
-                    env_steps=final.total_steps,
-                    episodes=total_episodes,
-                    capacity=final.capacity,
-                    lane_width=width,
-                    # items 0..width-1 seeded the lanes; everything past
-                    # that entered through the refill gather
-                    refill_events=final.next_item - jnp.int32(width),
-                    queue_wait=final.wait_sum,
-                )[None],
-                final.hist,
-            )
-        ),
+        telemetry=eval_telemetry,
     )
 
 
@@ -1557,6 +1676,9 @@ def _compacting_fns(
     stats_sync_axis=None,
     collect_telemetry=True,
     num_groups=1,
+    nonfinite_quarantine=False,
+    nonfinite_penalty=None,
+    nonfinite_sync_axis=None,
 ):
     """Jitted building blocks of the compacting runner, cached per config so
     repeated calls (every generation) hit XLA's compile cache."""
@@ -1675,26 +1797,38 @@ def _compacting_fns(
         return new_carry, _params_take(params_batch, sel), lane_ids[sel], scores_buf, eps_buf
 
     @jax.jit
-    def finalize_fn(carry, lane_ids, scores_buf, eps_buf):
+    def finalize_fn(carry, lane_ids, scores_buf, eps_buf, groups_full=None):
         scores_buf = scores_buf.at[lane_ids].set(carry.scores)
         eps_buf = eps_buf.at[lane_ids].set(carry.episodes_done)
         mean_scores = scores_buf / jnp.maximum(eps_buf, 1)
+        nf_bad = None
+        if nonfinite_quarantine:
+            mean_scores, nf_bad = _quarantine_nonfinite(
+                mean_scores,
+                penalty=nonfinite_penalty,
+                sync_axis=nonfinite_sync_axis,
+            )
         total_episodes = jnp.sum(eps_buf)
         if not collect_telemetry:
             telemetry = None
         elif num_groups > 1:
             # fold the surviving lanes' accumulators (dropped lanes folded at
             # their compaction boundary)
-            telemetry = pack_group_telemetry(
-                _fold_lane_counts(
-                    carry.group_counts,
-                    carry.lane_steps,
-                    carry.lane_episodes,
-                    carry.lane_groups,
-                    carry.t_global,
-                    num_groups,
-                )
+            group_counts = _fold_lane_counts(
+                carry.group_counts,
+                carry.lane_steps,
+                carry.lane_episodes,
+                carry.lane_groups,
+                carry.t_global,
+                num_groups,
             )
+            if nf_bad is not None:
+                # quarantine is per SOLUTION on the scattered-back buffers:
+                # the full-width per-solution group ids do the charging
+                group_counts = _nonfinite_group_counts(
+                    group_counts, nf_bad, groups_full, num_groups
+                )
+            telemetry = pack_group_telemetry(group_counts)
         else:
             telemetry = pack_group_telemetry(
                 pack_eval_telemetry(
@@ -1704,6 +1838,9 @@ def _compacting_fns(
                     # compaction, so occupancy credits the narrowing directly
                     capacity=carry.capacity,
                     lane_width=scores_buf.shape[0],
+                    nonfinite=(
+                        0 if nf_bad is None else jnp.sum(nf_bad.astype(jnp.int32))
+                    ),
                 )[None]
             )
         return mean_scores, total_episodes, telemetry
@@ -1732,6 +1869,8 @@ def run_vectorized_rollout_compacting(
     telemetry: bool = True,
     groups=None,
     num_groups: int = 1,
+    nonfinite_quarantine: bool = False,
+    nonfinite_penalty: Optional[float] = None,
 ) -> RolloutResult:
     """Episodes-contract evaluation with **lane compaction** — the
     host-orchestrated fast path for ``eval_mode="episodes"``.
@@ -1801,6 +1940,11 @@ def run_vectorized_rollout_compacting(
         compute_dtype,
         collect_telemetry=bool(telemetry),
         num_groups=num_groups,
+        nonfinite_quarantine=bool(nonfinite_quarantine),
+        nonfinite_penalty=nonfinite_penalty,
+    )
+    groups_full = (
+        jnp.asarray(groups, dtype=jnp.int32) if num_groups > 1 else None
     )
 
     if allowed_widths is None:
@@ -1819,12 +1963,7 @@ def run_vectorized_rollout_compacting(
     else:
         allowed_widths = tuple(sorted(int(w) for w in allowed_widths if w < n))
 
-    carry, params = init_fn(
-        params_batch,
-        key,
-        stats,
-        groups=(jnp.asarray(groups, dtype=jnp.int32) if num_groups > 1 else None),
-    )
+    carry, params = init_fn(params_batch, key, stats, groups=groups_full)
     lane_ids = jnp.arange(n, dtype=jnp.int32)
     scores_buf = jnp.zeros(n, dtype=jnp.float32)
     eps_buf = jnp.zeros(n, dtype=jnp.int32)
@@ -1837,7 +1976,7 @@ def run_vectorized_rollout_compacting(
         # O(k^2) tiny gather traces + k stepping programs, on throwaway
         # copies of the initial state
         c0, _ = chunk_fn(params, carry, int(chunk_size))
-        finalize_fn(c0, lane_ids, scores_buf, eps_buf)
+        finalize_fn(c0, lane_ids, scores_buf, eps_buf, groups_full)
         states = {c0.active.shape[0]: (c0, params, lane_ids, scores_buf, eps_buf)}
         for w in sorted(allowed_widths, reverse=True):
             narrowed = None
@@ -1848,7 +1987,7 @@ def run_vectorized_rollout_compacting(
                 continue
             c, p, ids, sb, eb = narrowed
             c, _ = chunk_fn(p, c, int(chunk_size))
-            finalize_fn(c, ids, sb, eb)
+            finalize_fn(c, ids, sb, eb, groups_full)
             states[w] = (c, p, ids, sb, eb)
         jax.block_until_ready(jax.tree_util.tree_leaves(states)[0])
 
@@ -1878,7 +2017,7 @@ def run_vectorized_rollout_compacting(
         prev_count = count
 
     mean_scores, total_episodes, eval_telemetry = finalize_fn(
-        carry, lane_ids, scores_buf, eps_buf
+        carry, lane_ids, scores_buf, eps_buf, groups_full
     )
     return RolloutResult(
         scores=mean_scores,
@@ -2011,6 +2150,8 @@ def _compacting_sharded_fns(
     stats_sync: bool = False,
     collect_telemetry: bool = True,
     num_groups: int = 1,
+    nonfinite_quarantine: bool = False,
+    nonfinite_penalty=None,
 ):
     from jax.sharding import PartitionSpec as P
 
@@ -2029,6 +2170,14 @@ def _compacting_sharded_fns(
         stats_sync_axis=axis_name if stats_sync else None,
         collect_telemetry=collect_telemetry,
         num_groups=num_groups,
+        nonfinite_quarantine=nonfinite_quarantine,
+        nonfinite_penalty=nonfinite_penalty,
+        # the worst-finite reduction pmins over the mesh so each shard
+        # quarantines to the GLOBAL worst finite score (bit-identity with
+        # the unsharded runner); a fixed penalty needs no collective
+        nonfinite_sync_axis=(
+            axis_name if (nonfinite_quarantine and nonfinite_penalty is None) else None
+        ),
     )
     carry_specs = _sharded_carry_specs(env, axis_name)
     params_spec = _params_shard_spec(params_kind, axis_name)
@@ -2136,10 +2285,10 @@ def _compacting_sharded_fns(
             compact_cache[new_width] = fn
         return fn(carry, params, lane_ids, scores_buf, eps_buf)
 
-    def sh_finalize_local(carry, lane_ids, scores_buf, eps_buf, stats0):
+    def sh_finalize_local(carry, lane_ids, scores_buf, eps_buf, groups_shard, stats0):
         c = _squeeze_shard_scalars(carry)
         mean_scores, eps_total_local, telemetry = finalize_fn(
-            c, lane_ids, scores_buf, eps_buf
+            c, lane_ids, scores_buf, eps_buf, groups_shard
         )
         if telemetry is None:
             telemetry_out = jnp.zeros((0,), dtype=jnp.int32)
@@ -2170,15 +2319,36 @@ def _compacting_sharded_fns(
             telemetry_out,
         )
 
-    sh_finalize = jax.jit(
-        jax.shard_map(
-            sh_finalize_local,
-            mesh=mesh,
-            in_specs=(carry_specs, lane, lane, lane, P()),
-            out_specs=(lane, P(), P(), P(), lane, P()),
-            check_vma=False,
+    if num_groups > 1:
+        sh_finalize = jax.jit(
+            jax.shard_map(
+                sh_finalize_local,
+                mesh=mesh,
+                in_specs=(carry_specs, lane, lane, lane, lane, P()),
+                out_specs=(lane, P(), P(), P(), lane, P()),
+                check_vma=False,
+            )
         )
-    )
+    else:
+        # no group ids to ship: close over the sentinel so the shard_map
+        # signature stays group-free (None is a zero-leaf pytree)
+        def sh_finalize_nogroups(carry, lane_ids, scores_buf, eps_buf, stats0):
+            return sh_finalize_local(
+                carry, lane_ids, scores_buf, eps_buf, None, stats0
+            )
+
+        inner = jax.jit(
+            jax.shard_map(
+                sh_finalize_nogroups,
+                mesh=mesh,
+                in_specs=(carry_specs, lane, lane, lane, P()),
+                out_specs=(lane, P(), P(), P(), lane, P()),
+                check_vma=False,
+            )
+        )
+
+        def sh_finalize(carry, lane_ids, scores_buf, eps_buf, groups, stats0):
+            return inner(carry, lane_ids, scores_buf, eps_buf, stats0)
 
     return sh_init, sh_chunk, sh_compact, sh_finalize
 
@@ -2208,6 +2378,8 @@ def run_vectorized_rollout_compacting_sharded(
     telemetry: bool = True,
     groups=None,
     num_groups: int = 1,
+    nonfinite_quarantine: bool = False,
+    nonfinite_penalty: Optional[float] = None,
 ) -> RolloutResult:
     """``run_vectorized_rollout_compacting`` with the population sharded over
     ``mesh[axis_name]``: each device narrows ITS working set as its lanes
@@ -2264,6 +2436,13 @@ def run_vectorized_rollout_compacting_sharded(
         bool(stats_sync),
         bool(telemetry),
         num_groups,
+        nonfinite_quarantine=bool(nonfinite_quarantine),
+        nonfinite_penalty=nonfinite_penalty,
+    )
+    groups_dev = (
+        jnp.asarray(groups, dtype=jnp.int32)
+        if num_groups > 1
+        else jnp.zeros((n,), dtype=jnp.int32)
     )
 
     if allowed_widths is None:
@@ -2292,7 +2471,7 @@ def run_vectorized_rollout_compacting_sharded(
         # compact pair a runtime jump can hit (mirrors the single-device
         # prewarm), so no trace+compile lands in a timing loop
         c0, _ = sh_chunk(params, carry, int(chunk_size))
-        sh_finalize(c0, lane_ids, scores_buf, eps_buf, stats0)
+        sh_finalize(c0, lane_ids, scores_buf, eps_buf, groups_dev, stats0)
         states = {
             c0.active.shape[0] // n_shards: (c0, params, lane_ids, scores_buf, eps_buf)
         }
@@ -2305,7 +2484,7 @@ def run_vectorized_rollout_compacting_sharded(
                 continue
             c, p, ids, sb, eb = narrowed
             c, _ = sh_chunk(p, c, int(chunk_size))
-            sh_finalize(c, ids, sb, eb, stats0)
+            sh_finalize(c, ids, sb, eb, groups_dev, stats0)
             states[w] = (c, p, ids, sb, eb)
         jax.block_until_ready(jax.tree_util.tree_leaves(states)[0])
 
@@ -2332,7 +2511,7 @@ def run_vectorized_rollout_compacting_sharded(
         prev_counts = counts
 
     mean_scores, merged_stats, total_steps, total_episodes, per_shard, eval_telemetry = (
-        sh_finalize(carry, lane_ids, scores_buf, eps_buf, stats0)
+        sh_finalize(carry, lane_ids, scores_buf, eps_buf, groups_dev, stats0)
     )
     result = RolloutResult(
         scores=mean_scores,

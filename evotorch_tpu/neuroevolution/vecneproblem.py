@@ -63,6 +63,8 @@ class VecNE(NEProblem):
         refill_config: Optional[dict] = None,
         solution_groups=None,
         slo=None,
+        nonfinite_quarantine: bool = True,
+        nonfinite_penalty: Optional[float] = None,
         compute_dtype=None,
         initial_bounds=(-0.00001, 0.00001),
         seed: Optional[int] = None,
@@ -150,6 +152,19 @@ class VecNE(NEProblem):
         else:
             self._solution_groups = None
             self._num_groups = 1
+        # non-finite quarantine (ISSUE 17, docs/resilience.md): inside the
+        # compiled eval programs, solutions whose mean score came back
+        # non-finite (diverged physics, overflowed bf16 reward sums) have
+        # their credit replaced — by the worst finite score in the batch
+        # (penalty=None) or a fixed penalty — BEFORE anything downstream
+        # (centered ranking orders NaN "best") can be poisoned. Identity on
+        # all-finite scores, so it defaults ON; quarantined counts surface
+        # as eval_nonfinite / eval_nonfinite_share status keys and in the
+        # per-group telemetry matrix (max_nonfinite_share SLO rule).
+        self._nonfinite_quarantine = bool(nonfinite_quarantine)
+        self._nonfinite_penalty = (
+            None if nonfinite_penalty is None else float(nonfinite_penalty)
+        )
         # SLO watchdog (observability/slo.py): declarative rules evaluated
         # against each generation's decoded telemetry; verdicts surface as
         # slo_ok / slo_violations status keys (logger columns for free)
@@ -367,6 +382,12 @@ class VecNE(NEProblem):
             # previous generation's figures (lag-by-one; shapes are identical
             # generation to generation, so the diagnostics are current)
             status.update(self._last_telemetry.as_status(prefix="eval_"))
+            # exact quarantine share: quarantined solutions over the batch
+            # size (the telemetry's own denominator is episodes, which
+            # differs at num_episodes > 1) — what max_nonfinite_share reads
+            status["eval_nonfinite_share"] = float(
+                self._last_telemetry.nonfinite
+            ) / max(1, len(batch))
         if self._last_group_telemetry is not None:
             # per-group keys (eval_g{g}_occupancy/...), emitted only at G>1
             status.update(self._last_group_telemetry.as_status(prefix="eval_"))
@@ -393,6 +414,8 @@ class VecNE(NEProblem):
             decrease_rewards_by=self._decrease_rewards_by,
             action_noise_stdev=self._action_noise_stdev,
             compute_dtype=self._compute_dtype,
+            nonfinite_quarantine=self._nonfinite_quarantine,
+            nonfinite_penalty=self._nonfinite_penalty,
         )
         if groups is not None:
             # num_groups stays the problem-GLOBAL count: sub-batch matrices
@@ -479,11 +502,40 @@ class VecNE(NEProblem):
                 )
                 scores.append(result.scores)
                 self._consume_rollout_side_effects(result)
-            batch.set_evals(jnp.concatenate(scores))
+            batch.set_evals(self._maybe_inject_nonfinite(jnp.concatenate(scores)))
             return
         result = self._rollout_batch(values, self.next_rng_key(), groups=groups)
         self._consume_rollout_side_effects(result)
-        batch.set_evals(result.scores)
+        batch.set_evals(self._maybe_inject_nonfinite(result.scores))
+
+    def _maybe_inject_nonfinite(self, scores):
+        """Deterministic score corruption (docs/resilience.md):
+        ``EVOTORCH_FAULTS="eval.scores:nonfinite@G[:share]"`` NaNs a seeded
+        share of this generation's scores at the host boundary — the
+        reproducible stand-in for diverged physics that the quarantine
+        acceptance tests drive. With quarantine enabled the same
+        replacement rule the engines compile (worst-finite / fixed
+        penalty) is applied to the corrupted vector, so an injected run
+        shows exactly what a quarantined diverging run shows."""
+        from ..resilience.faults import fault_point
+
+        rule = fault_point("eval.scores")
+        if rule is None or rule.kind != "nonfinite":
+            return scores
+        from ..observability.registry import counters
+        from .net.vecrl import _quarantine_nonfinite
+
+        scores = jnp.asarray(scores)
+        n = int(scores.shape[0])
+        k = max(1, int(round(rule.float_arg(0.25) * n)))
+        idx = np.random.default_rng(1234 + rule.count).choice(n, size=min(k, n), replace=False)
+        scores = scores.at[jnp.asarray(idx)].set(jnp.nan)
+        counters.increment("faults.injected_nonfinite", len(idx))
+        if self._nonfinite_quarantine:
+            scores, _ = _quarantine_nonfinite(
+                scores, penalty=self._nonfinite_penalty
+            )
+        return scores
 
     def _check_solution_groups(self, popsize: int):
         """The configured per-solution group ids, validated against the
@@ -576,6 +628,8 @@ class VecNE(NEProblem):
                 action_noise_stdev=self._action_noise_stdev,
                 compute_dtype=self._compute_dtype,
                 eval_mode=self._eval_mode,
+                nonfinite_quarantine=self._nonfinite_quarantine,
+                nonfinite_penalty=self._nonfinite_penalty,
             )
             if self._eval_mode == "episodes_refill":
                 # explicit knobs pass through GLOBAL (the helper's
@@ -651,6 +705,8 @@ class VecNE(NEProblem):
                 decrease_rewards_by=self._decrease_rewards_by,
                 action_noise_stdev=self._action_noise_stdev,
                 compute_dtype=self._compute_dtype,
+                nonfinite_quarantine=self._nonfinite_quarantine,
+                nonfinite_penalty=self._nonfinite_penalty,
                 prewarm=self._take_prewarm(n),
                 stats_sync=(obsnorm and self._obs_norm_sync == "step"),
                 groups=groups,
@@ -661,7 +717,7 @@ class VecNE(NEProblem):
                 self._obs_norm.stats = result.stats
             self._bump_counters(result.total_steps, result.total_episodes)
             self._consume_telemetry(result.telemetry)
-            batch.set_evals(result.scores)
+            batch.set_evals(self._maybe_inject_nonfinite(result.scores))
             self.update_status(self._report_counters(batch))
             return
 
@@ -676,7 +732,7 @@ class VecNE(NEProblem):
             self._obs_norm.stats = result.stats
         self._bump_counters(result.total_steps, result.total_episodes)
         self._consume_telemetry(result.telemetry)
-        batch.set_evals(result.scores)
+        batch.set_evals(self._maybe_inject_nonfinite(result.scores))
         self.update_status(self._report_counters(batch))
 
 

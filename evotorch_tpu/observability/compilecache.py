@@ -69,15 +69,21 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
     re-points the cache.
     """
     global _ENABLED_DIR
+    from ..resilience.retry import retry_call
+
     path = os.path.abspath(cache_dir or os.environ.get("EVOTORCH_COMPILE_CACHE_DIR") or DEFAULT_CACHE_DIR)
-    os.makedirs(path, exist_ok=True)
+    # the cache dir often lives on shared/network storage: creating it
+    # retries with bounded backoff (and is fault-injectable at site
+    # "compilecache.io"); jax itself degrades to uncached compiles when
+    # later entry reads/writes fail, so setup is the only hard IO edge
+    retry_call(os.makedirs, path, exist_ok=True, site="compilecache.io")
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     try:
         # Also cache XLA-internal autotuning artifacts where supported.
         jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
-    except Exception:
+    except Exception:  # graftlint: allow(swallow): older jax without the XLA-caches option; the main cache is already on
         pass
     _install_listener()
     _ENABLED_DIR = path

@@ -36,6 +36,10 @@ Slots (column order is the wire format — append only):
 ``queue_wait``       lane-steps spent idle while pending work existed —
                      refill-period / drain-ordering waiting; the
                      starvation-accounting numerator
+``nonfinite``        solutions whose final score was non-finite and was
+                     quarantined (replaced by the worst finite score / a
+                     fixed penalty) by the engines' ``nonfinite_quarantine``
+                     path (0 with quarantine off; docs/resilience.md)
 ===================  =======================================================
 
 Histogram buckets (columns ``TELEMETRY_WIDTH ..``): each refilled item's
@@ -83,6 +87,7 @@ _SLOTS = (
     "lane_width",
     "refill_events",
     "queue_wait",
+    "nonfinite",
 )
 TELEMETRY_WIDTH = len(_SLOTS)
 
@@ -96,7 +101,28 @@ QUEUE_WAIT_BUCKETS = len(QUEUE_WAIT_BUCKET_EDGES) + 1
 GROUP_TELEMETRY_WIDTH = TELEMETRY_WIDTH + QUEUE_WAIT_BUCKETS
 
 #: recorded in metrics manifests; bump on any wire-format change
-TELEMETRY_SCHEMA_VERSION = 2
+TELEMETRY_SCHEMA_VERSION = 3
+
+#: pre-quarantine wire widths (schema <= 2: no ``nonfinite`` slot) — still
+#: decoded, with the missing column read as 0, so recorded feeds and the
+#: golden wire vectors from older runs stay loadable
+_LEGACY_TELEMETRY_WIDTH = 6
+_LEGACY_GROUP_TELEMETRY_WIDTH = _LEGACY_TELEMETRY_WIDTH + QUEUE_WAIT_BUCKETS
+
+
+def _lift_legacy(values: np.ndarray) -> Optional[np.ndarray]:
+    """A schema<=2 wire (no ``nonfinite`` column) widened to the current
+    layout (nonfinite=0), or None when ``values`` is not a legacy shape."""
+    if values.shape == (_LEGACY_TELEMETRY_WIDTH,):
+        out = np.zeros((TELEMETRY_WIDTH,), dtype=np.int64)
+        out[:_LEGACY_TELEMETRY_WIDTH] = values
+        return out
+    if values.ndim == 2 and values.shape[1] == _LEGACY_GROUP_TELEMETRY_WIDTH:
+        out = np.zeros((values.shape[0], GROUP_TELEMETRY_WIDTH), dtype=np.int64)
+        out[:, :_LEGACY_TELEMETRY_WIDTH] = values[:, :_LEGACY_TELEMETRY_WIDTH]
+        out[:, TELEMETRY_WIDTH:] = values[:, _LEGACY_TELEMETRY_WIDTH:]
+        return out
+    return None
 
 #: inclusive UPPER edge of each non-overflow bucket (host-side quantile
 #: decode, Prometheus style: a quantile inside bucket b reports the bucket's
@@ -112,6 +138,7 @@ def pack_eval_telemetry(
     lane_width,
     refill_events=0,
     queue_wait=0,
+    nonfinite=0,
 ):
     """Stack the counters into the ``(TELEMETRY_WIDTH,)`` int32 v1 wire
     vector (call inside jit, on the final carry's scalars)."""
@@ -125,6 +152,7 @@ def pack_eval_telemetry(
             jnp.asarray(lane_width, dtype=jnp.int32),
             jnp.asarray(refill_events, dtype=jnp.int32),
             jnp.asarray(queue_wait, dtype=jnp.int32),
+            jnp.asarray(nonfinite, dtype=jnp.int32),
         ]
     )
 
@@ -167,6 +195,7 @@ class EvalTelemetry:
     lane_width: int = 0
     refill_events: int = 0
     queue_wait: int = 0
+    nonfinite: int = 0
 
     @classmethod
     def from_array(cls, array) -> "EvalTelemetry":
@@ -176,6 +205,9 @@ class EvalTelemetry:
         metered as a ``telemetry_fetches`` registry count so "zero extra
         transfers" stays auditable."""
         values = np.asarray(array)
+        legacy = _lift_legacy(values)
+        if legacy is not None:
+            values = legacy
         if values.shape == (TELEMETRY_WIDTH,):
             counters.increment("telemetry_fetches")
             return cls(**{name: int(values[i]) for i, name in enumerate(_SLOTS)})
@@ -214,13 +246,15 @@ class EvalTelemetry:
             f"{prefix}occupancy": round(self.occupancy, 6),
             f"{prefix}refill_events": self.refill_events,
             f"{prefix}queue_wait": self.queue_wait,
+            f"{prefix}nonfinite": self.nonfinite,
         }
 
     def summary(self) -> str:
         return (
             f"env_steps={self.env_steps} episodes={self.episodes} "
             f"occupancy={self.occupancy:.4f} lane_width={self.lane_width} "
-            f"refill_events={self.refill_events} queue_wait={self.queue_wait}"
+            f"refill_events={self.refill_events} queue_wait={self.queue_wait} "
+            f"nonfinite={self.nonfinite}"
         )
 
 
@@ -248,6 +282,9 @@ class GroupTelemetry:
         matrix with empty histogram buckets. Metered like
         :meth:`EvalTelemetry.from_array`."""
         values = np.asarray(array)
+        legacy = _lift_legacy(values)
+        if legacy is not None:
+            values = legacy
         if values.shape == (TELEMETRY_WIDTH,):
             row = np.zeros((1, GROUP_TELEMETRY_WIDTH), dtype=np.int64)
             row[0, :TELEMETRY_WIDTH] = values
@@ -321,6 +358,19 @@ class GroupTelemetry:
                 return float(_BUCKET_UPPER_EDGES[b])
         return float(_BUCKET_UPPER_EDGES[-1])
 
+    def nonfinite_share(self, group: Optional[int] = None) -> float:
+        """Share of finished episodes whose solution was quarantined for a
+        non-finite score — the ``max_nonfinite_share`` SLO fallback figure
+        when no exact status key is available. ``nonfinite`` counts
+        SOLUTIONS and ``episodes`` counts episodes, so the ratio is exact
+        at ``num_episodes=1`` and an under-estimate otherwise (each
+        quarantined solution contributed ``num_episodes`` episodes);
+        0.0 when nothing finished."""
+        rows = self.data if group is None else self.data[group : group + 1]
+        episodes = int(rows[:, _SLOTS.index("episodes")].sum())
+        nonfinite = int(rows[:, _SLOTS.index("nonfinite")].sum())
+        return (nonfinite / episodes) if episodes else 0.0
+
     def starvation_share(self, group: Optional[int] = None) -> float:
         """Share of refilled items that landed in the overflow (>= 64 step
         wait) bucket — the SLO watchdog's starvation figure (0.0 without
@@ -342,6 +392,7 @@ class GroupTelemetry:
                 out[f"{prefix}g{g}_env_steps"] = row.env_steps
                 out[f"{prefix}g{g}_episodes"] = row.episodes
                 out[f"{prefix}g{g}_queue_wait"] = row.queue_wait
+                out[f"{prefix}g{g}_nonfinite"] = row.nonfinite
         return out
 
     def summary(self) -> str:

@@ -44,6 +44,7 @@ from .devicemetrics import (
     _SLOTS,
 )
 from .registry import counters
+from ..resilience.retry import retry_call
 
 __all__ = ["MetricsHub"]
 
@@ -57,6 +58,7 @@ _GROUP_EXPORT_COLS = (
     "lane_width",
     "refill_events",
     "queue_wait",
+    "nonfinite",
     "occupancy",
 )
 
@@ -162,13 +164,25 @@ class MetricsHub:
         with self._lock:
             record["row"] = self._rows
             self._rows += 1
+            # writes retry with bounded backoff (resilience.retry): a
+            # transient IO blip must not kill the run its metrics describe;
+            # the site name makes the path fault-injectable (EVOTORCH_FAULTS
+            # "metricshub.write:raise@N")
             if self._prom:
-                self._write_prom(record)
+                retry_call(self._write_prom, record, site="metricshub.write")
             else:
-                with open(self._path, "a", encoding="utf-8") as fh:
-                    fh.write(json.dumps(record, sort_keys=True))
-                    fh.write("\n")
+                retry_call(self._append_jsonl, record, site="metricshub.write")
         return record
+
+    def _append_jsonl(self, record: Dict[str, Any]) -> None:
+        # crash-safe rows: flush + fsync per line, so a SIGKILL'd run keeps
+        # every row already emitted (readers skip at most the partial
+        # trailing line — slo._last_json_line tolerates one)
+        with open(self._path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(record, sort_keys=True))
+            fh.write("\n")
+            fh.flush()
+            os.fsync(fh.fileno())
 
     @staticmethod
     def _telemetry_fields(telemetry) -> Dict[str, Any]:
@@ -190,6 +204,7 @@ class MetricsHub:
             "eval_episodes": int(total.episodes),
             "eval_refill_events": int(total.refill_events),
             "eval_queue_wait": int(total.queue_wait),
+            "eval_nonfinite": int(total.nonfinite),
             "queue_wait_p50": telemetry.queue_wait_quantile(0.5),
             "queue_wait_p99": telemetry.queue_wait_quantile(0.99),
         }

@@ -150,6 +150,6 @@ def ensure_compile_timer() -> None:
             from jax import monitoring
 
             monitoring.register_event_duration_secs_listener(_on_duration_event)
-        except Exception:
+        except Exception:  # graftlint: allow(swallow): older jax without the monitoring hook; timing column degrades to absent
             pass
         _timer_installed = True

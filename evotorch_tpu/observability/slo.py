@@ -25,6 +25,14 @@ contract the multi-tenant eval service and island PBT need:
     fraction of nominal peak FLOPs — a BENCH_LEDGER=1 bench-line column)
     must be >= ``threshold``. Skipped when the key is absent; per-contract
     columns are checked by the bench CLI (``--min-model-efficiency``).
+``max_nonfinite_share``
+    the share of quarantined (non-finite-scored) solutions must be <=
+    ``threshold``. Reads the exact ``eval_nonfinite_share`` status key when
+    present (quarantined count / popsize); otherwise falls back to the
+    telemetry matrix's per-group episode-denominated share — which also
+    serves pinned-group rules (``group=g``). A diverging tenant shows up
+    here before its quarantined scores distort anyone's ranking; see
+    docs/resilience.md.
 
 The watchdog surfaces as searcher status keys (``slo_ok`` /
 ``slo_violations`` / ``slo_detail``) via ``VecNEProblem(slo=...)``, and as
@@ -36,7 +44,11 @@ a battery verdict via the CLI::
 which reads the LAST JSON line of a bench log (the bench.py output
 contract), applies the battery default rules (steady_compiles == 0 plus a
 global occupancy floor), writes a one-word ``pass``/``fail`` verdict file
-for tpu_watch.sh, prints a JSON verdict line, and exits 0/1.
+for tpu_watch.sh, prints a JSON verdict line, and exits 0/1 — or 2
+("insufficient") when the log has no decodable JSON line or the line
+carries none of the checked keys (a BENCH_TELEMETRY=0 line): missing data
+is distinguishable from failing data. A partial trailing line (crashed
+writer) is skipped, never a traceback.
 
 See docs/observability.md "Per-group telemetry & SLOs".
 """
@@ -64,6 +76,7 @@ RULE_KINDS = (
     "no_steady_compiles",
     "min_progress",
     "min_model_efficiency",
+    "max_nonfinite_share",
 )
 
 
@@ -171,6 +184,21 @@ class SLOWatchdog:
                     f"{rule.threshold:g}"
                 )
             return False
+        if rule.kind == "max_nonfinite_share":
+            share = None
+            if rule.group is None:
+                share = status.get("eval_nonfinite_share")
+            if share is None:
+                if telemetry is None:
+                    return None
+                share = telemetry.nonfinite_share(group=rule.group)
+            if float(share) > rule.threshold:
+                label = "global" if rule.group is None else f"g{rule.group}"
+                return (
+                    f"nonfinite_share {label}={float(share):.3f} > "
+                    f"{rule.threshold:g}"
+                )
+            return False
         if telemetry is None:
             return None
         groups = (
@@ -232,6 +260,7 @@ def check_bench_line(
     *,
     occupancy_floor: float = 0.1,
     min_model_efficiency: Optional[float] = None,
+    max_nonfinite_share: Optional[float] = None,
 ) -> SLOReport:
     """Apply the battery rules to one decoded bench.py JSON line.
 
@@ -256,6 +285,13 @@ def check_bench_line(
         checked += 1
         if float(occ) < occupancy_floor:
             violations.append(f"occupancy={float(occ):.3f} < {occupancy_floor:g}")
+    nfs = line.get("eval_nonfinite_share")
+    if max_nonfinite_share is not None and nfs is not None:
+        checked += 1
+        if float(nfs) > max_nonfinite_share:
+            violations.append(
+                f"eval_nonfinite_share={float(nfs):.3f} > {max_nonfinite_share:g}"
+            )
     eff = line.get("model_efficiency")
     if min_model_efficiency is not None and eff is not None:
         checked += 1
@@ -285,7 +321,12 @@ def check_bench_line(
     return SLOReport(ok=not violations, violations=tuple(violations), checked=checked)
 
 
-def _last_json_line(path: str) -> Dict[str, Any]:
+def _last_json_line(path: str) -> Optional[Dict[str, Any]]:
+    """The last decodable JSON line of the log, or None when there is none.
+
+    A crashed writer leaves a partial trailing line; that (and any other
+    non-JSON noise) is skipped, not raised — the last COMPLETE line wins.
+    """
     last = None
     with open(path, "r", encoding="utf-8") as fh:
         for raw in fh:
@@ -294,10 +335,8 @@ def _last_json_line(path: str) -> Dict[str, Any]:
                 continue
             try:
                 last = json.loads(raw)
-            except json.JSONDecodeError:
+            except json.JSONDecodeError:  # partial/corrupt row — skip it
                 continue
-    if last is None:
-        raise SystemExit(f"no JSON line found in {path}")
     return last
 
 
@@ -327,6 +366,13 @@ def _main(argv=None) -> int:
         "and per contract (default: unchecked; needs a BENCH_LEDGER=1 line)",
     )
     parser.add_argument(
+        "--max-nonfinite-share",
+        type=float,
+        default=None,
+        help="maximum acceptable eval_nonfinite_share (quarantined share of "
+        "the population; default: unchecked)",
+    )
+    parser.add_argument(
         "--verdict-out",
         metavar="PATH",
         default=None,
@@ -335,12 +381,23 @@ def _main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     line = _last_json_line(args.check_bench)
-    report = check_bench_line(
-        line,
-        occupancy_floor=args.occupancy_floor,
-        min_model_efficiency=args.min_model_efficiency,
-    )
-    verdict = "pass" if report.ok else "fail"
+    if line is None:
+        report = SLOReport(ok=False, violations=(), checked=0)
+    else:
+        report = check_bench_line(
+            line,
+            occupancy_floor=args.occupancy_floor,
+            min_model_efficiency=args.min_model_efficiency,
+            max_nonfinite_share=args.max_nonfinite_share,
+        )
+    if report.checked == 0:
+        # no decodable line, or a line with none of the checked keys (e.g.
+        # BENCH_TELEMETRY=0): missing data is not a pass and not a fail
+        verdict, code = "insufficient", 2
+    elif report.ok:
+        verdict, code = "pass", 0
+    else:
+        verdict, code = "fail", 1
     if args.verdict_out:
         with open(args.verdict_out, "w", encoding="utf-8") as fh:
             fh.write(verdict + "\n")
@@ -355,7 +412,7 @@ def _main(argv=None) -> int:
             sort_keys=True,
         )
     )
-    return 0 if report.ok else 1
+    return code
 
 
 if __name__ == "__main__":

@@ -60,21 +60,40 @@ def init_distributed(
     def _enable_cpu_collectives():
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        except Exception:
+        except Exception:  # graftlint: allow(swallow): a jax without the option: CPU multi-process unsupported anyway
             pass  # a jax without the option: CPU multi-process unsupported
+
+    # the handshake retries with bounded backoff (resilience.retry): the
+    # usual first-boot race — this process dials before the coordinator
+    # binds its port — is a transient RuntimeError/OSError, not a config
+    # error, and should not kill a pod job that would succeed 200ms later
+    from ..resilience.retry import retry_call
 
     if coordinator_address is not None:
         _enable_cpu_collectives()
-        jax.distributed.initialize(
+        retry_call(
+            jax.distributed.initialize,
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            site="distributed.init",
+            retries=5,
+            base_delay=0.2,
+            max_delay=5.0,
+            exceptions=(OSError, RuntimeError),
         )
         return True
     cluster_hints = ("COORDINATOR_ADDRESS", "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS")
     if any(h in os.environ for h in cluster_hints):
         _enable_cpu_collectives()
-        jax.distributed.initialize()
+        retry_call(
+            jax.distributed.initialize,
+            site="distributed.init",
+            retries=5,
+            base_delay=0.2,
+            max_delay=5.0,
+            exceptions=(OSError, RuntimeError),
+        )
         return True
     return False
 

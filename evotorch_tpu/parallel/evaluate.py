@@ -222,7 +222,13 @@ def _normalize_kind(kind) -> str:
     return str(kind)
 
 
-_RESERVED_ROLLOUT_KWARGS = {"lane_ids", "stats_sync_axis", "seed_stride", "num_valid"}
+_RESERVED_ROLLOUT_KWARGS = {
+    "lane_ids",
+    "stats_sync_axis",
+    "seed_stride",
+    "num_valid",
+    "nonfinite_sync_axis",
+}
 
 
 def _check_reserved(rollout_kwargs, what: str):
@@ -477,6 +483,16 @@ def _shard_map_rollout_evaluator(
     collect_groups = groups_global is not None and num_groups > 1
     if collect_groups:
         groups_global = jnp.asarray(groups_global, dtype=jnp.int32)
+
+    # non-finite quarantine on this explicit path: the worst-finite
+    # reduction must pmin over the mesh so the sharded replacement score is
+    # the GLOBAL worst finite one (the GSPMD path's reduction is global by
+    # construction); a fixed penalty needs no collective
+    if (
+        rollout_kwargs.get("nonfinite_quarantine")
+        and rollout_kwargs.get("nonfinite_penalty") is None
+    ):
+        rollout_kwargs["nonfinite_sync_axis"] = axis_name
 
     def build(kind: str, popsize: int):
         # tuned-config cache: cache widths are GLOBAL, divided per shard with

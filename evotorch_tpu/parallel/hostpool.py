@@ -27,14 +27,19 @@ them in (the delta is a sum, so scheduling does not change what merges home).
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 import pickle
+import signal
 import time
 import traceback
+from collections import deque
+from multiprocessing.connection import wait as _conn_wait
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..observability.tracer import span
+from ..resilience.faults import fault_point
 
 __all__ = ["HostEvaluatorPool"]
 
@@ -50,14 +55,14 @@ _MAIN_GUARD_HINT = (
 )
 
 
-def _worker_main(problem_bytes: bytes, seed: int, task_q, result_q):
+def _worker_main(problem_bytes: bytes, seed: int, conn):
     # force the CPU backend BEFORE any jax device use: the axon PJRT plugin
     # pins jax_platforms at interpreter startup and the TPU is single-client
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
+    except Exception:  # graftlint: allow(swallow): platform may be pre-pinned; the worker only must never touch the TPU
         pass
     import jax.numpy as jnp
 
@@ -67,14 +72,17 @@ def _worker_main(problem_bytes: bytes, seed: int, task_q, result_q):
         problem._is_main = False
         problem.manual_seed(seed)
     except Exception:
-        result_q.put(("fatal", -1, traceback.format_exc()))
+        conn.send(("fatal", -1, traceback.format_exc()))
         return
-    result_q.put(("ready", -1, None))
+    conn.send(("ready", -1, None))
 
     from ..core import SolutionBatch
 
     while True:
-        msg = task_q.get()
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):  # the main process went away
+            return
         if msg is None:
             return
         kind, idx, values, sync = msg
@@ -85,18 +93,30 @@ def _worker_main(problem_bytes: bytes, seed: int, task_q, result_q):
                 values = jnp.asarray(values)
             batch = SolutionBatch(problem, len(values), values=values)
             problem.evaluate(batch)
-            result_q.put(
-                ("ok", idx, np.asarray(batch.evals), problem._make_sync_data_for_main())
+            result = (
+                "ok", idx, np.asarray(batch.evals), problem._make_sync_data_for_main()
             )
         except Exception:
-            result_q.put(("error", idx, traceback.format_exc()))
+            result = ("error", idx, traceback.format_exc())
+        try:
+            conn.send(result)
+        except (EOFError, OSError):  # the main process went away
+            return
 
 
 class HostEvaluatorPool:
     """N worker processes, each holding a pickled clone of the Problem
     (exactly the reference's ``EvaluationActor`` arrangement,
-    ``core.py:115-270``); tasks are pulled from a shared queue, giving the
-    same dynamic load balancing as ``ActorPool.map_unordered``."""
+    ``core.py:115-270``); pieces are handed out one at a time over
+    per-worker pipes (a pull scheduler: each finished piece fetches the
+    next), giving the same dynamic load balancing as
+    ``ActorPool.map_unordered``. Per-worker pipes instead of shared queues
+    is a fault-tolerance decision, not a style one: an ``mp.Queue`` reader
+    holds the queue's shared lock WHILE blocked in ``get()``, so a worker
+    SIGKILL'd at the wrong moment (OOM killer, fault injection) leaves the
+    lock held forever and deadlocks every sibling — with pipes, a death can
+    only sever the dead worker's own channel, which the respawn path
+    discards along with the corpse (docs/resilience.md)."""
 
     def __init__(
         self,
@@ -119,51 +139,120 @@ class HostEvaluatorPool:
         # full slow host rollout. None disables, relying on worker-death
         # detection alone.
         self._timeout = timeout
-        ctx = mp.get_context("spawn")
-        self._task_q = ctx.Queue()
-        self._result_q = ctx.Queue()
-        problem_bytes = pickle.dumps(problem)
+        self._ctx = mp.get_context("spawn")
+        # kept for respawn-and-redispatch: a dead worker is replaced by a
+        # fresh clone built from the same pickled problem + the same seed,
+        # so a respawned worker is behaviorally the worker it replaces
+        self._problem_bytes = pickle.dumps(problem)
         if seeds is None:
             seeds = [None] * self._num_workers
+        self._seeds = [
+            int(seeds[i]) if seeds[i] is not None else i
+            for i in range(self._num_workers)
+        ]
+        # lifetime respawn cap: tolerate transient deaths, but a worker that
+        # keeps dying (a deterministically-crashing objective) must
+        # eventually fail the round instead of thrashing forever
+        self._respawn_budget = 2 * self._num_workers
         self._procs = []
-        for i in range(self._num_workers):
-            seed = seeds[i] if seeds[i] is not None else i
-            p = ctx.Process(
-                target=_worker_main,
-                args=(problem_bytes, int(seed), self._task_q, self._result_q),
-                daemon=True,
-            )
-            p.start()
-            self._procs.append(p)
+        self._conns = []
+        for seed in self._seeds:
+            proc, conn = self._spawn(seed)
+            self._procs.append(proc)
+            self._conns.append(conn)
         self._await_ready()
+
+    def _spawn(self, seed: int):
+        parent_conn, child_conn = self._ctx.Pipe(duplex=True)
+        p = self._ctx.Process(
+            target=_worker_main,
+            args=(self._problem_bytes, int(seed), child_conn),
+            daemon=True,
+        )
+        p.start()
+        # close the parent's copy of the child end so a dead worker's pipe
+        # EOFs instead of blocking (EOF is the death signal the sync loop
+        # reads)
+        child_conn.close()
+        return p, parent_conn
+
+    def _worker_index(self, conn) -> int:
+        for i, c in enumerate(self._conns):
+            if c is conn:
+                return i
+        raise KeyError("connection does not belong to this pool")
+
+    def _respawn_dead(self, pending, inflight, evals, broken=()) -> int:
+        """Replace every dead worker with a same-seed clone on a FRESH pipe
+        and put its unfinished piece back on the pending queue; returns how
+        many were respawned (0 = everyone is alive). ``broken`` lists worker
+        indices whose pipe already failed — their process is reaped here
+        even if it has not fully exited yet."""
+        from ..observability.registry import counters
+
+        respawned = 0
+        for wi, proc in enumerate(self._procs):
+            if proc.is_alive() and wi not in broken:
+                continue
+            if proc.is_alive():  # severed pipe but lingering process
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.join(timeout=10)
+            counters.increment("hostpool.worker_deaths")
+            if self._respawn_budget <= 0:
+                raise RuntimeError(
+                    "a host evaluation worker died mid-evaluation and the "
+                    f"respawn budget ({2 * self._num_workers}) is exhausted — "
+                    "the objective is likely crashing deterministically"
+                )
+            self._respawn_budget -= 1
+            # the piece that died with the worker goes back to the front of
+            # the queue; duplicates (a piece the worker finished but whose
+            # result was torn mid-send) resolve first-wins in the sync loop
+            piece = inflight[wi]
+            inflight[wi] = None
+            if piece is not None and evals[piece] is None:
+                counters.increment("hostpool.redispatched_pieces")
+                pending.appendleft(piece)
+            try:
+                self._conns[wi].close()  # the corpse's pipe end
+            except Exception:  # graftlint: allow(swallow): already-severed pipe; closing is best-effort fd hygiene
+                pass
+            with span("hostpool.respawn", "hostpool", worker=wi, exitcode=proc.exitcode):
+                self._procs[wi], self._conns[wi] = self._spawn(self._seeds[wi])
+            counters.increment("hostpool.respawns")
+            respawned += 1
+        return respawned
 
     def _await_ready(self):
         """Block until every worker finished bootstrapping (unpickled its
         problem clone), failing fast — with the child traceback — if any died
         on the way (e.g. an unpicklable objective, or a script missing its
         ``__main__`` guard)."""
-        ready = 0
+        ready: set = set()
         deadline = time.monotonic() + _STARTUP_TIMEOUT
-        while ready < self._num_workers:
-            try:
-                msg = self._result_q.get(timeout=1.0)
-            except Exception:
-                if time.monotonic() > deadline:
-                    self.shutdown()
-                    raise RuntimeError("host evaluation workers timed out during startup")
-                if not all(p.is_alive() for p in self._procs):
+        while len(ready) < self._num_workers:
+            if time.monotonic() > deadline:
+                self.shutdown()
+                raise RuntimeError("host evaluation workers timed out during startup")
+            waiting = [c for i, c in enumerate(self._conns) if i not in ready]
+            for conn in _conn_wait(waiting, timeout=1.0):
+                wi = self._worker_index(conn)
+                try:
+                    msg = conn.recv()
+                except Exception:
                     self.shutdown()
                     raise RuntimeError(
                         "a host evaluation worker died during startup. "
                         + _MAIN_GUARD_HINT
                     )
-                continue
-            status, _, payload = msg
-            if status == "fatal":
-                self.shutdown()
-                raise RuntimeError(f"host evaluation worker failed to start:\n{payload}")
-            if status == "ready":
-                ready += 1
+                status, _, payload = msg
+                if status == "fatal":
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"host evaluation worker failed to start:\n{payload}"
+                    )
+                if status == "ready":
+                    ready.add(wi)
 
     @property
     def num_workers(self) -> int:
@@ -190,21 +279,45 @@ class HostEvaluatorPool:
             raise
 
     def _evaluate_pieces(self, pieces_values, sync_data):
-        # prepare ALL transport payloads before enqueuing anything: a
+        # prepare ALL transport payloads before dispatching anything: a
         # conversion error must not leave orphan tasks in flight
         import jax
 
         transport = []
-        with span("hostpool.dispatch", "hostpool", pieces=len(pieces_values)):
-            for values in pieces_values:
-                if isinstance(values, jax.Array):  # jax array -> numpy for pickling
-                    values = np.asarray(values)
-                transport.append(values)  # ObjectArray and ndarray both pickle
-            n = len(transport)
-            for i, v in enumerate(transport):
-                self._task_q.put(("eval", i, v, sync_data))
+        for values in pieces_values:
+            if isinstance(values, jax.Array):  # jax array -> numpy for pickling
+                values = np.asarray(values)
+            transport.append(values)  # ObjectArray and ndarray both pickle
+        n = len(transport)
         evals: List[Optional[np.ndarray]] = [None] * n
         sync_back: List[dict] = []
+        pending = deque(range(n))
+        inflight: List[Optional[int]] = [None] * self._num_workers
+
+        def dispatch(wi: int) -> None:
+            # hand the next pending piece to worker `wi`; a send that fails
+            # (the worker just died) puts the piece back, and the death
+            # sweep below respawns the worker and re-dispatches to the clone
+            if inflight[wi] is not None or not pending:
+                return
+            i = pending.popleft()
+            try:
+                self._conns[wi].send(("eval", i, transport[i], sync_data))
+            except (OSError, ValueError):
+                pending.appendleft(i)
+            else:
+                inflight[wi] = i
+
+        with span("hostpool.dispatch", "hostpool", pieces=n):
+            for wi in range(self._num_workers):
+                dispatch(wi)
+        # deterministic worker-death injection (docs/resilience.md):
+        # EVOTORCH_FAULTS="hostpool.worker:kill@R[:W]" SIGKILLs worker W at
+        # the R-th round, exercising the respawn-and-redispatch path below
+        rule = fault_point("hostpool.worker")
+        if rule is not None and rule.kind == "kill" and self._procs:
+            victim = self._procs[int(rule.float_arg(0)) % len(self._procs)]
+            os.kill(victim.pid, signal.SIGKILL)
         received = 0
         deadline = None if self._timeout is None else time.monotonic() + self._timeout
         # the actor-sync window: the main process blocks here gathering the
@@ -212,39 +325,75 @@ class HostEvaluatorPool:
         with span("hostpool.sync", "hostpool", pieces=n):
             while received < n:
                 try:
-                    msg = self._result_q.get(timeout=1.0)
-                except Exception as e:
-                    if not all(p.is_alive() for p in self._procs):
+                    readable = _conn_wait(list(self._conns), timeout=1.0)
+                except OSError:
+                    readable = []
+                broken: List[int] = []
+                results = []
+                for conn in readable:
+                    wi = self._worker_index(conn)
+                    try:
+                        results.append((wi, conn.recv()))
+                    except Exception:  # graftlint: allow(swallow): EOF/torn message = worker death; _respawn_dead counts it in hostpool.worker_deaths
+                        # the worker is gone, and only ITS channel dies with
+                        # it (per-worker pipes exist exactly so a death can
+                        # poison nothing shared)
+                        broken.append(wi)
+                if broken or not all(p.is_alive() for p in self._procs):
+                    # respawn same-seed clones on fresh pipes, re-queue their
+                    # in-flight pieces, and hand the clones work immediately
+                    # (the task waits in the pipe buffer while they boot)
+                    self._respawn_dead(pending, inflight, evals, broken)
+                    for wi in range(self._num_workers):
+                        dispatch(wi)
+                    if deadline is not None:
+                        deadline = time.monotonic() + self._timeout
+                for wi, msg in results:
+                    status, idx, *payload = msg
+                    if status == "ready":  # a respawned worker finished booting
+                        dispatch(wi)
+                        continue
+                    if status != "ok":
                         raise RuntimeError(
-                            "a host evaluation worker died mid-evaluation"
-                        ) from e
-                    if deadline is not None and time.monotonic() > deadline:
-                        raise RuntimeError("host evaluation pool timed out") from e
-                    continue
-                status, idx, *payload = msg
-                if status != "ok":
-                    raise RuntimeError(f"host evaluation worker failed:\n{payload[-1]}")
-                evals[idx] = payload[0]
-                sync_back.append(payload[1])
-                received += 1
-                if deadline is not None:
-                    deadline = time.monotonic() + self._timeout  # progress resets it
+                            f"host evaluation worker failed:\n{payload[-1]}"
+                        )
+                    if inflight[wi] == idx:
+                        inflight[wi] = None
+                    if evals[idx] is None:  # duplicate after redispatch loses
+                        evals[idx] = payload[0]
+                        sync_back.append(payload[1])
+                        received += 1
+                        if deadline is not None:
+                            deadline = time.monotonic() + self._timeout
+                    dispatch(wi)
+                if (
+                    not readable
+                    and deadline is not None
+                    and time.monotonic() > deadline
+                ):
+                    raise RuntimeError("host evaluation pool timed out")
         return evals, sync_back
 
     def shutdown(self):
-        for _ in self._procs:
+        for conn in self._conns:
             try:
-                self._task_q.put(None)
-            except Exception:
+                conn.send(None)
+            except Exception:  # graftlint: allow(swallow): pipe may already be severed during teardown; shutdown is best-effort
                 pass
         for p in self._procs:
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
+        for conn in self._conns:
+            try:
+                conn.close()
+            except Exception:  # graftlint: allow(swallow): pipe may already be severed during teardown; shutdown is best-effort
+                pass
         self._procs = []
+        self._conns = []
 
     def __del__(self):
         try:
             self.shutdown()
-        except Exception:
+        except Exception:  # graftlint: allow(swallow): destructor during interpreter teardown must never raise
             pass

@@ -1,0 +1,105 @@
+"""Bounded exponential-backoff retry for fragile host-side ops.
+
+The compiled eval programs are deterministic; the host edges around them —
+coordinator handshakes (``jax.distributed.initialize``), compile-cache IO,
+MetricsHub writes, worker-pool dispatch — fail for boring transient
+reasons (NFS blips, a coordinator that is still binding its port, a dying
+actor). This wraps them uniformly:
+
+- bounded attempts with exponential backoff (deterministic delays — no
+  jitter, so test timing is reproducible; the delays are host-side sleeps,
+  never on the device path),
+- :mod:`~evotorch_tpu.observability.registry` counters
+  (``retry.<site>.attempts`` / ``.retries`` / ``.giveups``) so a run that
+  limped through on retries says so in the counter snapshot,
+- a tracer span per retried attempt (``retry:<site>``) so stalls show up
+  on the host timeline next to the phase spans,
+- a :func:`~evotorch_tpu.resilience.faults.fault_point` at every attempt,
+  which makes every retried op fault-injectable for free
+  (``EVOTORCH_FAULTS="<site>:raise@1"`` exercises the retry path;
+  ``...@1+`` with ``retries`` exceeded exercises the give-up path).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from ..observability import tracer
+from ..observability.registry import counters
+from .faults import fault_point
+
+__all__ = ["retry_call", "retryable"]
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    site: str,
+    retries: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    exceptions: Tuple[Type[BaseException], ...] = (OSError,),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)`` with up to ``retries`` retries.
+
+    ``exceptions`` is the retryable set (default ``OSError`` — the IO
+    family, which also covers :class:`InjectedFault`); anything else
+    propagates immediately. ``on_retry(attempt, exc)`` runs before each
+    backoff sleep (hostpool uses it to respawn the dead worker). The final
+    failure re-raises the last exception unchanged — retrying is
+    transparent, not exception-rewriting.
+    """
+    attempts = int(retries) + 1
+    delay = float(base_delay)
+    last: Optional[BaseException] = None
+    for attempt in range(1, attempts + 1):
+        counters.increment(f"retry.{site}.attempts")
+        try:
+            fault_point(site)
+            return fn(*args, **kwargs)
+        except exceptions as exc:  # noqa: PERF203 — the slow path IS the point
+            last = exc
+            if attempt >= attempts:
+                counters.increment(f"retry.{site}.giveups")
+                raise
+            counters.increment(f"retry.{site}.retries")
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            with tracer.span(f"retry:{site}", cat="resilience", attempt=attempt,
+                             error=type(exc).__name__):
+                time.sleep(delay)
+            delay = min(delay * 2.0, float(max_delay))
+    raise AssertionError(last)  # unreachable
+
+
+def retryable(
+    *,
+    site: str,
+    retries: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    exceptions: Tuple[Type[BaseException], ...] = (OSError,),
+):
+    """Decorator form of :func:`retry_call` for fixed call sites."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return retry_call(
+                fn,
+                *args,
+                site=site,
+                retries=retries,
+                base_delay=base_delay,
+                max_delay=max_delay,
+                exceptions=exceptions,
+                **kwargs,
+            )
+
+        return wrapped
+
+    return deco

@@ -1,0 +1,180 @@
+"""Durable run checkpoints: versioned bundles a SIGKILL cannot corrupt.
+
+:mod:`evotorch_tpu.checkpoint` has the leaf primitives (orbax pytree
+save/load, whole-searcher pickle); what a long run needs is one durable
+*bundle* per checkpoint interval carrying everything resume requires —
+the searcher (whose pickle transitively contains the functional search
+state, PRNG chain, obs-norm statistics and interaction counters), the
+generation number, the registry counter snapshot, tuned-config
+provenance, the git sha, and a schema version — written so that a crash
+at ANY instant leaves the directory loadable:
+
+- **atomic**: payload goes to a tmp file, is fsync'd, then ``os.replace``d
+  into place (readers and crashes see either the old bundle set or the
+  new one, never a half-written file);
+- **self-verifying**: a fixed magic plus the payload's SHA-256 ride in the
+  header, so truncation/corruption is *detected* at load, not discovered
+  as a confusing unpickling error;
+- **redundant**: keep-last-K retention (default 3), and
+  :meth:`RunCheckpointer.load_latest` walks bundles newest-first,
+  skipping invalid ones (counter ``checkpoint.corrupt_skipped``) — one
+  bad bundle costs one interval of progress, not the run.
+
+Because the searcher state is a pure pytree and every stochastic choice
+flows from the PRNG key stored inside it, a killed-and-resumed run
+replays the uninterrupted run's trajectory **bit-identically**
+(tests/test_resilience.py asserts this, including through SIGKILL).
+
+See docs/resilience.md for the bundle format and the resume wiring in
+``examples/locomotion_curve.py``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import pickle
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["RunCheckpointer", "CorruptBundleError", "BUNDLE_SCHEMA_VERSION"]
+
+#: bump when the payload layout changes incompatibly; loaders refuse
+#: bundles from a NEWER schema (an older writer cannot know what it means)
+BUNDLE_SCHEMA_VERSION = 1
+
+_MAGIC = b"EVTRUNB1"  # 8 bytes: format id + container version
+_BUNDLE_RE = re.compile(r"^bundle_(\d{8})\.ckpt$")
+
+
+class CorruptBundleError(RuntimeError):
+    """A bundle file failed magic/digest/schema verification."""
+
+
+def _git_sha() -> Optional[str]:
+    from ..observability.metricshub import _git_sha as sha
+
+    return sha()
+
+
+class RunCheckpointer:
+    """Write/read durable run bundles in a directory.
+
+    ``save(generation, state)`` persists one bundle (``state`` is an
+    arbitrary picklable dict — by convention ``{"searcher": searcher,
+    ...}``); ``load_latest()`` returns ``(generation, state)`` from the
+    newest VALID bundle, or ``None`` on an empty/fully-corrupt directory.
+    ``every`` makes ``maybe_save`` a cadence helper so call sites don't
+    carry modulo logic.
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3, every: int = 1):
+        if int(keep) < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        if int(every) < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self.directory = os.path.abspath(directory)
+        self.keep = int(keep)
+        self.every = int(every)
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ write
+    def maybe_save(self, generation: int, state: Dict[str, Any]) -> Optional[str]:
+        """``save`` when the generation lands on the cadence, else None."""
+        if int(generation) % self.every != 0:
+            return None
+        return self.save(generation, state)
+
+    def save(self, generation: int, state: Dict[str, Any]) -> str:
+        """Atomically persist one bundle; returns its path."""
+        from ..observability.registry import counters
+
+        payload = pickle.dumps(
+            {
+                "schema": BUNDLE_SCHEMA_VERSION,
+                "generation": int(generation),
+                "git_sha": _git_sha(),
+                "registry": counters.snapshot(),
+                "state": state,
+            },
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        digest = hashlib.sha256(payload).digest()
+        path = os.path.join(self.directory, f"bundle_{int(generation):08d}.ckpt")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(_MAGIC)
+            fh.write(digest)
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        counters.increment("checkpoint.bundles_written")
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        paths = self.bundle_paths()
+        for path in paths[: max(0, len(paths) - self.keep)]:
+            try:
+                os.remove(path)
+            except OSError:  # graftlint: allow(swallow): retention is best-effort; a busy/unlinkable old bundle is harmless
+                pass
+
+    # ------------------------------------------------------------------- read
+    def bundle_paths(self) -> List[str]:
+        """Existing bundle paths, oldest first (by generation)."""
+        entries = []
+        for name in os.listdir(self.directory):
+            m = _BUNDLE_RE.match(name)
+            if m:
+                entries.append((int(m.group(1)), os.path.join(self.directory, name)))
+        return [path for _, path in sorted(entries)]
+
+    @staticmethod
+    def read_bundle(path: str) -> Tuple[int, Dict[str, Any]]:
+        """Verify + decode one bundle; raises :class:`CorruptBundleError`."""
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError as exc:
+            raise CorruptBundleError(f"unreadable bundle {path}: {exc}") from exc
+        if len(blob) < len(_MAGIC) + 32 or not blob.startswith(_MAGIC):
+            raise CorruptBundleError(
+                f"{path} is not a run bundle (bad magic or truncated header)"
+            )
+        digest = blob[len(_MAGIC) : len(_MAGIC) + 32]
+        payload = blob[len(_MAGIC) + 32 :]
+        if hashlib.sha256(payload).digest() != digest:
+            raise CorruptBundleError(
+                f"{path} failed its SHA-256 check (truncated or corrupted "
+                "write) — falling back to an older bundle is safe"
+            )
+        try:
+            record = pickle.load(io.BytesIO(payload))
+        except Exception as exc:
+            raise CorruptBundleError(f"{path} payload does not unpickle: {exc}") from exc
+        schema = record.get("schema")
+        if not isinstance(schema, int) or schema > BUNDLE_SCHEMA_VERSION:
+            raise CorruptBundleError(
+                f"{path} has bundle schema {schema!r}; this build reads <= "
+                f"{BUNDLE_SCHEMA_VERSION}"
+            )
+        return int(record["generation"]), record["state"]
+
+    def load_latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """The newest valid bundle's ``(generation, state)``, else None.
+
+        Invalid bundles are skipped (newest-first) with a counter bump —
+        a partial write from the crash that necessitated the resume is the
+        expected case, not an exception.
+        """
+        from ..observability.registry import counters
+
+        for path in reversed(self.bundle_paths()):
+            try:
+                return self.read_bundle(path)
+            except CorruptBundleError:  # graftlint: allow(swallow): counted + fall back to the next-newest bundle — that fallback IS the feature
+                counters.increment("checkpoint.corrupt_skipped")
+        return None

@@ -1,0 +1,80 @@
+"""First-device-use watchdog: a dead accelerator tunnel should be an
+error, not an infinite hang.
+
+On this stack the PJRT plugin pins the platform at interpreter startup;
+when the TPU tunnel is unhealthy, the FIRST backend use (``jax.devices()``
+or the first dispatch) blocks forever — CLAUDE.md's documented failure
+mode, until now survivable only by shell-level timeouts. The probe runs
+that first use on a daemon thread with a deadline and turns the hang into
+an actionable :class:`DeviceProbeTimeout`.
+
+The probe also catches the plugin's OTHER documented failure: a *silent
+CPU fallback* where ``jax.devices()`` returns promptly but with
+``CpuDevice`` rows — pass ``expect_accelerator=True`` to make that an
+error too (scripts that would otherwise false-fire a TPU battery onto the
+CPU).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+__all__ = ["DeviceProbeTimeout", "probe_devices"]
+
+_ENV_TIMEOUT = "EVOTORCH_DEVICE_TIMEOUT"
+
+
+class DeviceProbeTimeout(RuntimeError):
+    """First device use did not complete within the deadline."""
+
+
+def probe_devices(
+    timeout: Optional[float] = None, *, expect_accelerator: bool = False
+) -> List:
+    """Force the first backend use under a deadline; return the devices.
+
+    ``timeout`` defaults to ``EVOTORCH_DEVICE_TIMEOUT`` (seconds), else 60.
+    On timeout the probe thread is left parked (daemonic — it cannot be
+    cancelled, which is exactly why the hang must be detected here and not
+    discovered at the first rollout) and :class:`DeviceProbeTimeout`
+    explains how to force the CPU backend instead.
+    """
+    if timeout is None:
+        timeout = float(os.environ.get(_ENV_TIMEOUT, "60"))
+    result: dict = {}
+
+    def _probe():
+        try:
+            import jax
+
+            result["devices"] = jax.devices()
+        except BaseException as exc:  # surfaced on the caller thread below  # graftlint: allow(swallow): handed to the caller thread via the result dict and re-raised there
+            result["error"] = exc
+
+    t = threading.Thread(target=_probe, name="device-probe", daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        from ..observability.registry import counters
+
+        counters.increment("watchdog.device_probe.timeouts")
+        raise DeviceProbeTimeout(
+            f"first device use still hanging after {timeout:g}s — the "
+            "accelerator tunnel is likely down. Force the CPU backend "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=8 and "
+            "jax.config.update('jax_platforms', 'cpu') BEFORE first device "
+            "use) or fix the tunnel and retry."
+        )
+    if "error" in result:
+        raise result["error"]
+    devices = result["devices"]
+    if expect_accelerator and devices and devices[0].platform == "cpu":
+        raise DeviceProbeTimeout(
+            "device probe returned CPU devices but an accelerator was "
+            "required — the PJRT plugin silently fell back to the host "
+            "(known failure mode; see CLAUDE.md). Refusing to run an "
+            "accelerator workload on the CPU."
+        )
+    return devices

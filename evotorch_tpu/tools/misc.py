@@ -302,7 +302,7 @@ class ErroneousResult:
     def call(f, *args, **kwargs):
         try:
             return f(*args, **kwargs)
-        except Exception as e:  # noqa: BLE001 — marker deliberately captures all
+        except Exception as e:  # noqa: BLE001 — marker deliberately captures all  # graftlint: allow(swallow): ErroneousResult deliberately captures the failure as a value
             return ErroneousResult(e)
 
 

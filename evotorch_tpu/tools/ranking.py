@@ -121,18 +121,48 @@ rankers: Dict[str, Callable] = {
 }
 
 
+def _nonfinite_to_worst(x: jnp.ndarray, *, higher_is_better: bool) -> jnp.ndarray:
+    """Non-finite fitnesses replaced by the worst finite one (per batch row).
+
+    Without this, argsort's total order places NaN LAST — i.e. a diverged
+    rollout ranks "best" and every utility-weighted update chases it; under
+    ``normalized``/``raw`` a single NaN poisons the whole utility vector.
+    Defense in depth behind the engines' score quarantine
+    (docs/resilience.md): identity on all-finite input, so guarded ranking
+    is bit-identical to unguarded whenever nothing is wrong. An
+    all-non-finite row falls back to 0 utility everywhere.
+    """
+    finite = jnp.isfinite(x)
+    big = jnp.asarray(jnp.finfo(x.dtype).max, dtype=x.dtype)
+    if higher_is_better:
+        worst = jnp.min(jnp.where(finite, x, big), axis=-1, keepdims=True)
+        worst = jnp.where(worst >= big, jnp.zeros((), x.dtype), worst)
+    else:
+        worst = jnp.max(jnp.where(finite, x, -big), axis=-1, keepdims=True)
+        worst = jnp.where(worst <= -big, jnp.zeros((), x.dtype), worst)
+    return jnp.where(finite, x, worst)
+
+
 def rank(
     fitnesses,
     ranking_method: str = "raw",
     *,
     higher_is_better: bool,
+    guard_nonfinite: bool = True,
 ) -> jnp.ndarray:
     """Dispatcher (reference ``ranking.py:189``). Works along the last axis so
-    leading batch dimensions (batched searches) are supported natively."""
+    leading batch dimensions (batched searches) are supported natively.
+
+    ``guard_nonfinite`` (default on) sanitizes NaN/Inf fitnesses to the
+    worst finite value before shaping — see :func:`_nonfinite_to_worst`;
+    pass False for the reference's unguarded argsort semantics."""
     try:
         fn = rankers[ranking_method]
     except KeyError:
         raise ValueError(
             f"Unknown ranking method {ranking_method!r}; expected one of {sorted(rankers)}"
         )
-    return fn(jnp.asarray(fitnesses), higher_is_better=higher_is_better)
+    x = jnp.asarray(fitnesses)
+    if guard_nonfinite and jnp.issubdtype(x.dtype, jnp.floating):
+        x = _nonfinite_to_worst(x, higher_is_better=higher_is_better)
+    return fn(x, higher_is_better=higher_is_better)

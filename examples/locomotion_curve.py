@@ -56,6 +56,16 @@ def parse_args():
                    help="policy DSL; default: 2x64-tanh MLP")
     p.add_argument("--out", default=None)
     p.add_argument("--seed", type=int, default=0)
+    # durable checkpoint/resume (resilience.RunCheckpointer,
+    # docs/resilience.md): with --checkpoint-dir the run saves a bundle
+    # every --checkpoint-every generations and AUTO-RESUMES from the newest
+    # valid bundle on restart — a SIGKILL costs at most one interval, and
+    # the resumed trajectory is bit-identical to the uninterrupted one
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=10)
+    p.add_argument("--checkpoint-keep", type=int, default=3)
+    p.add_argument("--no-resume", action="store_true",
+                   help="ignore existing bundles; start fresh (still saves)")
     return p.parse_args()
 
 
@@ -66,6 +76,14 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # first-device-use watchdog (docs/resilience.md): when the
+        # accelerator tunnel is down, jax's first backend use hangs forever;
+        # turn that into an actionable error before hours of curve are at
+        # stake (EVOTORCH_DEVICE_TIMEOUT overrides the 60s deadline)
+        from evotorch_tpu.resilience import probe_devices
+
+        probe_devices()
     import jax
     import jax.numpy as jnp
 
@@ -110,6 +128,32 @@ def main():
         popsize_max=args.popsize_max,
         lowrank_rank=args.lowrank_rank,
     )
+
+    # durable resume: restore the whole searcher (functional state + PRNG
+    # chain + obs-norm stats + counters ride inside its pickle) from the
+    # newest valid bundle, then continue from the next generation appending
+    # to the same JSONL — bit-identical to the run that was never killed
+    ckpt = None
+    start_gen = 1
+    if args.checkpoint_dir:
+        from evotorch_tpu.resilience import RunCheckpointer
+
+        ckpt = RunCheckpointer(
+            args.checkpoint_dir,
+            keep=args.checkpoint_keep,
+            every=args.checkpoint_every,
+        )
+        if not args.no_resume:
+            loaded = ckpt.load_latest()
+            if loaded is not None:
+                gen_done, state = loaded
+                searcher = state["searcher"]
+                problem = searcher.problem
+                start_gen = gen_done + 1
+                print(
+                    json.dumps({"resumed_from_generation": gen_done}),
+                    flush=True,
+                )
 
     # center-evaluation envs: the full reward, and (when the env pays an
     # alive bonus) a zero-bonus copy so the velocity term reports separately
@@ -165,7 +209,7 @@ def main():
 
     t_start = time.time()
     with open(out_path, "a") as f:
-        for gen in range(1, args.generations + 1):
+        for gen in range(start_gen, args.generations + 1):
             searcher.step()
             opt = searcher.optimizer
             row = {
@@ -209,6 +253,10 @@ def main():
             f.flush()
             if hub is not None:
                 hub.emit(row, telemetry=problem.last_group_telemetry)
+            if ckpt is not None:
+                # save AFTER the row is durably in the JSONL so a resume
+                # never replays an already-written generation
+                ckpt.maybe_save(gen, {"searcher": searcher})
     print(
         json.dumps(
             {

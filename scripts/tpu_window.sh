@@ -129,9 +129,16 @@ run ledger_flagship 2400 python -m evotorch_tpu.observability.report \
 #    then Humanoid 100 gens with the velocity term reported separately
 # lr/radius pinned to the r4 values (the runner's defaults now derive from
 # --max-speed) so the r5 curve stays comparable to halfcheetah_cpu_r4
+# --checkpoint-dir: the curves are the longest steps in the battery, and a
+# tunnel drop mid-curve used to cost the WHOLE run (the .ok stamp is
+# all-or-nothing). With durable bundles (resilience.RunCheckpointer,
+# docs/resilience.md) the re-fired step auto-resumes from the newest valid
+# bundle — bit-identical to the uninterrupted run — so a drop costs at most
+# one checkpoint interval.
 run curve_halfcheetah 10800 python examples/locomotion_curve.py --env halfcheetah \
   --popsize 10000 --generations 200 --episode-length 250 --eval-every 10 \
   --center-lr 0.06 --radius-init 0.27 \
+  --checkpoint-dir "$OUT/ck_halfcheetah" --checkpoint-every 10 \
   --bf16 --out "$OUT/halfcheetah_tpu.jsonl"
 # the reference's pybullet-humanoid recipe shape (rl_clipup.py:199-206):
 # tiny-traj 200 steps, popsize 10k, MLP-64, max_speed 0.15, obs-norm, and
@@ -141,6 +148,7 @@ run curve_humanoid 10800 python examples/locomotion_curve.py --env humanoid \
   --popsize 10000 --generations 100 --episode-length 200 --eval-every 5 \
   --decrease-rewards-by auto --max-speed 0.15 \
   --network "Linear(obs_length, 64) >> Tanh() >> Linear(64, act_length)" \
+  --checkpoint-dir "$OUT/ck_humanoid" --checkpoint-every 5 \
   --bf16 --out "$OUT/humanoid_tpu.jsonl"
 
 # every step above either .ok'd or failed; report complete only if all OK

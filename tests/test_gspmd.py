@@ -31,6 +31,7 @@ from evotorch_tpu.parallel import (
     parse_mesh_shape,
 )
 from evotorch_tpu.observability import EvalTelemetry, GroupTelemetry
+from evotorch_tpu.observability.devicemetrics import GROUP_TELEMETRY_WIDTH
 
 
 @pytest.fixture(scope="module")
@@ -202,7 +203,7 @@ def test_gspmd_per_group_matrix_bit_identical_across_meshes(cartpole_setup):
     )
     ref = run_vectorized_rollout(env, policy, values, key, stats, **kwargs)
     tref = GroupTelemetry.from_array(ref.telemetry)
-    assert tref.data.shape == (2, 14)
+    assert tref.data.shape == (2, GROUP_TELEMETRY_WIDTH)
     for mesh_shape in ({"pop": 8}, {"pop": 4, "model": 2}):
         ev = make_sharded_rollout_evaluator(
             env, policy, mesh=make_mesh(mesh_shape), **kwargs
@@ -266,7 +267,7 @@ def test_shard_map_per_group_psum_additivity(cartpole_setup):
     np.testing.assert_array_equal(np.asarray(res1.scores), np.asarray(res2.scores))
     t1 = GroupTelemetry.from_array(res1.telemetry)
     t2 = GroupTelemetry.from_array(res2.telemetry)
-    assert t2.data.shape == (2, 14)
+    assert t2.data.shape == (2, GROUP_TELEMETRY_WIDTH)
     s1, s2 = t1.total(), t2.total()
     for field in (
         "env_steps", "episodes", "capacity", "lane_width",
@@ -292,7 +293,7 @@ def test_compacting_sharded_per_group_counts(cartpole_setup):
     )
     np.testing.assert_array_equal(np.asarray(result.scores), np.asarray(ref.scores))
     t = GroupTelemetry.from_array(result.telemetry)
-    assert t.data.shape == (2, 14)
+    assert t.data.shape == (2, GROUP_TELEMETRY_WIDTH)
     tref = GroupTelemetry.from_array(ref.telemetry)
     s, sref = t.total(), tref.total()
     for field in ("env_steps", "episodes", "capacity", "lane_width"):

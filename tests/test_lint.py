@@ -613,6 +613,101 @@ def test_timing_ignores_host_only_timing_and_jit_decorated_defs():
 
 
 # ---------------------------------------------------------------------------
+# swallow
+# ---------------------------------------------------------------------------
+
+
+def test_swallow_flags_silent_broad_handlers():
+    findings = _lint(
+        """
+        def f():
+            try:
+                risky()
+            except:
+                pass
+
+        def g():
+            try:
+                risky()
+            except Exception:
+                x = 1
+        """,
+        checkers=["swallow"],
+    )
+    details = sorted(f.detail for f in findings)
+    assert details == ["swallow:bare except", "swallow:except Exception"]
+
+
+def test_swallow_accepts_reported_or_narrow_handlers():
+    findings = _lint(
+        """
+        import logging
+        import traceback
+
+        from evotorch_tpu.observability.registry import counters
+
+        log = logging.getLogger(__name__)
+
+        def logged():
+            try:
+                risky()
+            except Exception:
+                log.warning("risky failed")
+
+        def counted():
+            try:
+                risky()
+            except Exception:
+                counters.increment("risky.failures")
+
+        def reraised():
+            try:
+                risky()
+            except Exception:
+                cleanup()
+                raise
+
+        def captured():
+            try:
+                risky()
+            except Exception:
+                tb = traceback.format_exc()
+                record(tb)
+
+        def narrow():
+            try:
+                risky()
+            except (KeyError, OSError):
+                pass
+        """,
+        checkers=["swallow"],
+    )
+    assert findings == []
+
+
+def test_swallow_allow_comment_suppresses_with_reason():
+    silent = """
+        def f():
+            try:
+                risky()
+            except Exception:  # graftlint: allow(swallow): teardown is best-effort
+                pass
+        """
+    assert _lint(silent, checkers=["swallow"]) == []
+    reasonless = """
+        def f():
+            try:
+                risky()
+            except Exception:  # graftlint: allow(swallow)
+                pass
+        """
+    findings = _lint(reasonless, checkers=["swallow"])
+    details = sorted(f.detail for f in findings)
+    # the reasonless allow does NOT suppress, and is itself a finding
+    assert details == ["missing-reason", "swallow:except Exception"]
+
+
+# ---------------------------------------------------------------------------
 # scoped allow-comments
 # ---------------------------------------------------------------------------
 

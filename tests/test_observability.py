@@ -197,15 +197,15 @@ def test_refill_queue_wait_counts_gated_idle_lanes():
 def _group_matrix():
     """A synthetic two-group matrix: g0 healthy, g1 starved."""
     data = np.zeros((2, GROUP_TELEMETRY_WIDTH), dtype=np.int64)
-    data[0, :TELEMETRY_WIDTH] = [90, 10, 100, 4, 10, 5]
-    data[1, :TELEMETRY_WIDTH] = [2, 0, 100, 4, 6, 300]
+    data[0, :TELEMETRY_WIDTH] = [90, 10, 100, 4, 10, 5, 1]
+    data[1, :TELEMETRY_WIDTH] = [2, 0, 100, 4, 6, 300, 0]
     data[0, TELEMETRY_WIDTH:] = [8, 1, 1, 0, 0, 0, 0, 0]
     data[1, TELEMETRY_WIDTH:] = [0, 0, 0, 0, 0, 1, 0, 5]
     return data
 
 
 def test_group_telemetry_decode_total_and_quantiles():
-    assert TELEMETRY_SCHEMA_VERSION == 2
+    assert TELEMETRY_SCHEMA_VERSION == 3
     gt = GroupTelemetry.from_array(_group_matrix())
     assert gt.num_groups == 2
     assert gt.hist.shape == (2, QUEUE_WAIT_BUCKETS)
@@ -220,6 +220,9 @@ def test_group_telemetry_decode_total_and_quantiles():
     # starvation = the overflow bucket's share of refills
     assert gt.starvation_share(group=0) == 0.0
     assert gt.starvation_share(group=1) == pytest.approx(5 / 6)
+    # nonfinite (the quarantine column, schema 3) over finished episodes
+    assert gt.nonfinite_share(group=0) == pytest.approx(1 / 10)
+    assert gt.nonfinite_share(group=1) == 0.0
     # addition pads the shorter matrix (sub-batch additivity)
     summed = gt + GroupTelemetry.from_array(_group_matrix()[:1])
     assert summed.total().env_steps == 92 + 90
