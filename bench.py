@@ -29,10 +29,19 @@ sentinel count over every timed loop — anything but 0 is a retrace bug).
 A/B baseline). Each mode also reports ``queue_wait_p50``/``queue_wait_p99``
 (decoded from the on-device queue-wait histograms); ``BENCH_GROUPS=G``
 round-robins group ids across the population and switches the wire to the
-per-group ``(G, 14)`` matrix (the per-group accounting overhead shape);
+per-group matrix (the per-group accounting overhead shape);
 ``EVOTORCH_METRICS=path`` streams the line + decoded per-group telemetry +
 counter registry through the MetricsHub (JSONL manifest-first, or
 Prometheus text with a ``.prom`` suffix).
+
+The SEARCH-HEALTH plane (docs/observability.md "Search health") rides the
+same wire: per-mode ``score_mean``/``score_std`` decoded from the on-device
+float32 score-statistics block (per-group lists
+``score_mean_by_group``/``score_std_by_group`` at ``BENCH_GROUPS>1``), with
+the primary contract's pair hoisted top-level — what ``slo --check-bench
+--max-score-collapse`` / ``--min-score-snr`` read. ``BENCH_HEALTH=0``
+compiles the health-free (schema v3) programs — both the overhead A/B
+baseline and the byte-compat escape hatch.
 
 The program LEDGER (docs/observability.md "Program ledger") adds, per
 contract and hoisted top-level for the primary one: ``compile_seconds``
@@ -186,6 +195,10 @@ def main():
         episode_length=episode_length,
         compute_dtype=compute_dtype,
         telemetry=cfg["telemetry"],
+        # BENCH_HEALTH=0: compile the health-plane-free (schema v3)
+        # programs — the overhead A/B baseline for the score-statistics
+        # block (docs/observability.md "Search health")
+        health=cfg["health"],
     )
     num_groups = cfg["num_groups"] if cfg["telemetry"] else 0
     if num_groups > 1:
@@ -358,6 +371,22 @@ def main():
             # 0.0 (absent entirely under BENCH_TELEMETRY=0)
             modes[mode]["queue_wait_p50"] = mode_groups.queue_wait_quantile(0.5)
             modes[mode]["queue_wait_p99"] = mode_groups.queue_wait_quantile(0.99)
+        if mode_groups is not None and mode_groups.has_health:
+            # search-health plane (schema v4): the contract's score
+            # statistics, decoded from the same wire — absent entirely
+            # under BENCH_HEALTH=0 so those lines stay byte-compatible
+            stats = mode_groups.score_stats()
+            if stats["count"] > 0:
+                modes[mode]["score_mean"] = round(stats["mean"], 6)
+                modes[mode]["score_std"] = round(stats["std"], 6)
+            if mode_groups.num_groups > 1:
+                rows = mode_groups.to_rows()
+                modes[mode]["score_mean_by_group"] = [
+                    round(r["score_mean"], 6) for r in rows
+                ]
+                modes[mode]["score_std_by_group"] = [
+                    round(r["score_std"], 6) for r in rows
+                ]
         if record is not None:
             # the compact record covers ONE full-width chunk, not a whole
             # generation: its per-step denominator is the chunk's executed
@@ -497,6 +526,14 @@ def main():
         "compute_dtype": str(compute_dtype.__name__ if compute_dtype else "float32"),
         "backend": "cpu-fallback" if use_cpu else "tpu",
     }
+    primary_groups = group_telemetry_by_mode.get(eval_mode)
+    if primary_groups is not None and primary_groups.has_health:
+        # the primary contract's score statistics hoisted top-level (what
+        # `slo --check-bench --max-score-collapse/--min-score-snr` reads);
+        # absent entirely under BENCH_HEALTH=0 / BENCH_TELEMETRY=0 so
+        # those lines stay byte-compatible
+        line["score_mean"] = modes[eval_mode].get("score_mean")
+        line["score_std"] = modes[eval_mode].get("score_std")
     if cfg["tuned"]:
         # schedule provenance (absent entirely under BENCH_TUNED=0 so the
         # line stays byte-compatible with pre-autotuner rounds): the

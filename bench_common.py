@@ -76,6 +76,12 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
         # the A/B baseline proving the zero-sync telemetry costs nothing
         # (docs/observability.md); default on
         "telemetry": os.environ.get("BENCH_TELEMETRY", "1") != "0",
+        # BENCH_HEALTH=0 compiles the health-plane-free (schema v3) eval
+        # programs and drops the score_mean/score_std columns — both the
+        # overhead A/B baseline for the search-health plane and the
+        # byte-compat escape hatch (docs/observability.md "Search health");
+        # default on (meaningful only with telemetry on)
+        "health": os.environ.get("BENCH_HEALTH", "1") != "0",
         # BENCH_GROUPS=G (with telemetry on) assigns round-robin group ids
         # across the population and switches the telemetry wire to the
         # per-group (G, 14) matrix — the per-group accounting overhead A/B

@@ -17,6 +17,7 @@ from .funcpgpe import (
     pgpe_ask,
     pgpe_ask_lowrank,
     pgpe_ask_trunk_delta,
+    pgpe_health,
     pgpe_tell,
     pgpe_tell_lowrank,
     pgpe_tell_trunk_delta,
@@ -60,6 +61,7 @@ __all__ = [
     "pgpe_tell_lowrank",
     "pgpe_ask_trunk_delta",
     "pgpe_tell_trunk_delta",
+    "pgpe_health",
     "SNESState",
     "snes",
     "snes_ask",

@@ -30,6 +30,7 @@ __all__ = [
     "pgpe_tell_lowrank",
     "pgpe_ask_trunk_delta",
     "pgpe_tell_trunk_delta",
+    "pgpe_health",
 ]
 
 
@@ -143,6 +144,21 @@ def pgpe_tell(state: PGPEState, values, evals) -> PGPEState:
         max_change=state.stdev_max_change,
     )
     return replace(state, optimizer_state=new_optimizer_state, stdev=new_stdev)
+
+
+def pgpe_health(state: PGPEState) -> dict:
+    """Algorithm-health scalars for the search-health plane
+    (docs/observability.md "Search health").
+
+    Pure and jit-safe: returns DEVICE scalars (``stdev_norm`` always;
+    ``velocity_norm`` when the optimizer state carries a velocity, i.e.
+    ClipUp or momentum SGD), so callers can compute them inside a compiled
+    generation step and apply the usual lag-by-one host read."""
+    out = {"stdev_norm": jnp.linalg.norm(state.stdev)}
+    velocity = getattr(state.optimizer_state, "velocity", None)
+    if velocity is not None:
+        out["velocity_norm"] = jnp.linalg.norm(velocity)
+    return out
 
 
 def pgpe_ask_trunk_delta(key, state: PGPEState, *, popsize: int, rank: int, policy):

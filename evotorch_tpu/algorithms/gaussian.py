@@ -155,6 +155,21 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         self._optimizer = self._initialize_optimizer(self._center_learning_rate, optimizer, optimizer_config)
         self._ranking_method = None if ranking_method is None else str(ranking_method)
 
+        # algorithm-health scalars (docs/observability.md "Search health"):
+        # same device-scalar discipline as _mean_eval / basis_capture — the
+        # update step only ENQUEUES device scalars; the host float
+        # materializes when the status key is actually read
+        self._center_update_norm_dev = None
+        # bound methods, not lambdas: the curve runner's checkpoint bundles
+        # pickle the whole searcher, and a lambda getter would break that
+        self.add_status_getters(
+            {
+                "stdev_norm": self._get_stdev_norm,
+                "center_update_norm": self._get_center_update_norm,
+                "clipup_velocity_norm": self._get_clipup_velocity_norm,
+            }
+        )
+
         ensure = problem.ensure_tensor_length_and_dtype
         self._stdev_min = None if stdev_min is None else ensure(stdev_min, about="stdev_min")
         self._stdev_max = None if stdev_max is None else ensure(stdev_max, about="stdev_max")
@@ -217,6 +232,23 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         # _mean_eval is kept as a device scalar (no sync in the hot loop);
         # the host float materializes only when the status is actually read
         return None if self._mean_eval is None else float(self._mean_eval)
+
+    def _get_stdev_norm(self):
+        # computed on READ from the current distribution parameters — no
+        # per-step bookkeeping, and value-identical to the host-side
+        # jnp.linalg.norm(status["stdev"]) it replaces in the examples
+        return float(jnp.linalg.norm(self._distribution.parameters["sigma"]))
+
+    def _get_center_update_norm(self):
+        return (
+            None
+            if self._center_update_norm_dev is None
+            else float(self._center_update_norm_dev)
+        )
+
+    def _get_clipup_velocity_norm(self):
+        velocity = getattr(self._optimizer, "_velocity", None)
+        return None if velocity is None else float(jnp.linalg.norm(velocity))
 
     def _get_popsize(self):
         return 0 if self._population is None else len(self._population)
@@ -423,8 +455,14 @@ class GaussianSearchAlgorithm(SearchAlgorithm, SinglePopulationAlgorithmMixin):
         learning_rates = {"mu": self._center_learning_rate, "sigma": self._stdev_learning_rate}
         optimizers = {"mu": self._optimizer} if self._optimizer is not None else None
         old_sigma = self._distribution.parameters["sigma"]
+        old_mu = self._distribution.parameters["mu"]
         new_dist = self._distribution.update_parameters(
             gradients, learning_rates=learning_rates, optimizers=optimizers
+        )
+        # enqueued as a device scalar; synced on status read (lag-free here
+        # because the read happens after the step's dispatch has retired)
+        self._center_update_norm_dev = jnp.linalg.norm(
+            new_dist.parameters["mu"] - old_mu
         )
         if (
             self._stdev_min is not None

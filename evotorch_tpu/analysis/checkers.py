@@ -1,4 +1,4 @@
-"""The graftlint checkers — seven JAX-specific static analyses.
+"""The graftlint checkers — nine JAX/telemetry-specific static analyses.
 
 =============  ==============================================================
 checker        what it catches
@@ -32,6 +32,11 @@ checker        what it catches
                shrugged off becomes invisible at every later debugging
                session. Intentional swallows carry
                ``# graftlint: allow(swallow): reason``
+``telemetry-schema``  hard-coded telemetry wire column indices (int literals
+               subscripting ``*telemetry*``/``group_counts``/``lane_counts``
+               arrays) outside ``observability/devicemetrics.py`` — the
+               schema-versioned layout has ONE owner; everywhere else must
+               index via its named constants or the decoded accessors
 =============  ==============================================================
 
 All checkers are pure-AST (no imports executed). Each returns
@@ -1091,6 +1096,77 @@ def check_swallow(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# (i) telemetry wire-schema literals
+# ---------------------------------------------------------------------------
+
+#: the single module allowed to spell raw telemetry column indices — it OWNS
+#: the wire schema (TELEMETRY_SCHEMA_VERSION and the column-layout constants)
+_TELEMETRY_SCHEMA_OWNER = "evotorch_tpu/observability/devicemetrics.py"
+
+#: bare names that carry the raw int32 telemetry wire even without
+#: "telemetry" in their spelling (the decoded per-group/per-lane matrices)
+_TELEMETRY_WIRE_NAMES = {"group_counts", "lane_counts"}
+
+
+def _telemetry_wire_base(node: ast.Subscript) -> Optional[str]:
+    """Dotted name of the subscripted expression when it looks like a raw
+    telemetry wire array; unwraps chained subscripts (``telemetry[g][15]``)."""
+    base: ast.AST = node.value
+    while isinstance(base, ast.Subscript):
+        base = base.value
+    name = dotted_name(base)
+    if name is None:
+        return None
+    if "telemetry" in name.lower():
+        return name
+    if name.rpartition(".")[2] in _TELEMETRY_WIRE_NAMES:
+        return name
+    return None
+
+
+def check_telemetry_schema(mod: ModuleInfo, project: ProjectInfo) -> List[Finding]:
+    """The telemetry matrix layout is versioned (schema v1 ``(6,)`` through
+    v4 ``(G, 20)``); a hard-coded column index outside devicemetrics.py is a
+    latent decode bug — it silently reads the wrong counter the next time a
+    column is inserted. Index through the named layout constants / decoded
+    :class:`GroupTelemetry` fields instead."""
+    if mod.path == _TELEMETRY_SCHEMA_OWNER:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        base = _telemetry_wire_base(node)
+        if base is None:
+            continue
+        literals = sorted(
+            {
+                n.value
+                for n in ast.walk(node.slice)
+                if isinstance(n, ast.Constant)
+                and isinstance(n.value, int)
+                and not isinstance(n.value, bool)
+            }
+        )
+        if not literals:
+            continue
+        lits = ",".join(str(v) for v in literals)
+        findings.append(
+            mod.finding(
+                "telemetry-schema",
+                node,
+                f"hard-coded telemetry column index [{lits}] on `{base}`: the "
+                "wire layout is schema-versioned and owned by "
+                "observability/devicemetrics.py — index via its named layout "
+                "constants or the decoded GroupTelemetry accessors, or "
+                "annotate `# graftlint: allow(telemetry-schema): reason`",
+                f"telemetry-index:{base}:[{lits}]",
+            )
+        )
+    return findings
+
+
 CHECKERS = {
     "prng": check_prng,
     "retrace": check_retrace,
@@ -1100,4 +1176,5 @@ CHECKERS = {
     "dtype": check_dtype,
     "timing": check_timing,
     "swallow": check_swallow,
+    "telemetry-schema": check_telemetry_schema,
 }

@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from ...observability.devicemetrics import (
     QUEUE_WAIT_BUCKETS,
     TELEMETRY_WIDTH,
+    append_health_block,
+    compute_health_block,
     pack_eval_telemetry,
     pack_group_telemetry,
     queue_wait_bucket_index,
@@ -221,7 +223,7 @@ class Policy:
     _COL_REFILL,
     _COL_WAIT,
     _COL_NONFINITE,
-) = range(7)
+) = range(TELEMETRY_WIDTH)
 
 
 def _empty_lane_groups():
@@ -319,6 +321,22 @@ def _nonfinite_group_counts(group_counts, bad, groups, num_groups: int):
     )
 
 
+def _health_telemetry(telemetry, scores, groups, num_groups, num_valid):
+    """Append the v4 search-health block to a packed telemetry matrix,
+    computed from the final post-quarantine per-solution mean scores. The
+    scores (and group ids) are sliced to the static ``num_valid`` BEFORE
+    the reductions so padded and unpadded programs reduce over identical
+    shapes — the bit-identity contract of docs/observability.md "Search
+    health"."""
+    if num_valid is not None:
+        scores = scores[:num_valid]
+        if groups is not None:
+            groups = groups[:num_valid]
+    return append_health_block(
+        telemetry, compute_health_block(scores, groups, num_groups)
+    )
+
+
 class RolloutResult(NamedTuple):
     scores: jnp.ndarray  # (N,) mean episodic return per solution
     stats: CollectedStats  # obs-norm statistics collected during the rollout
@@ -326,9 +344,10 @@ class RolloutResult(NamedTuple):
     total_episodes: jnp.ndarray  # scalar: episodes finished
     # packed on-device eval telemetry (observability.devicemetrics): one
     # (G, GROUP_TELEMETRY_WIDTH) int32 matrix (G=1 without per-group
-    # accounting) computed inside the same jitted program as the scores —
-    # fetching it is part of the same transfer, never a new dispatch. None
-    # when the engine ran with telemetry=False.
+    # accounting) — or (G, HEALTH_TELEMETRY_WIDTH) with the health plane
+    # on — computed inside the same jitted program as the scores; fetching
+    # it is part of the same transfer, never a new dispatch. None when the
+    # engine ran with telemetry=False.
     telemetry: Any = None
 
 
@@ -754,6 +773,7 @@ def _make_step(
         "refill_period",
         "seed_stride",
         "telemetry",
+        "health",
         "num_valid",
         "num_groups",
         "trunk_block",
@@ -783,6 +803,7 @@ def run_vectorized_rollout(
     refill_period: int = 1,
     seed_stride: Optional[int] = None,
     telemetry: bool = True,
+    health: bool = True,
     num_valid: Optional[int] = None,
     groups=None,
     num_groups: int = 1,
@@ -819,6 +840,17 @@ def run_vectorized_rollout(
     ``observability.devicemetrics``). ``telemetry=False`` compiles the
     accumulator-free program — the A/B baseline for measuring that the
     accumulators cost nothing.
+
+    ``health`` (default on, only meaningful with ``telemetry``): append the
+    float32 search-health plane — per-group ``count, sum, sumsq, min, max``
+    of the final per-solution mean scores, bit-cast into ``HEALTH_WIDTH``
+    extra int32 columns — computed ONCE at program end from the
+    post-quarantine scores (no loop-carry cost). ``health=False`` keeps
+    the pre-v4 ``(G, GROUP_TELEMETRY_WIDTH)`` wire byte-compatible (the
+    ``BENCH_HEALTH=0`` escape hatch). Explicit shard_map callers should
+    pass ``health=False`` and append a mesh-global block themselves (see
+    ``parallel/evaluate.py``) — a per-shard block would be garbled by the
+    telemetry psum.
 
     ``groups`` / ``num_groups`` (ISSUE 15): per-group telemetry. ``groups``
     is an ``(N,)`` int32 array of group ids in ``[0, num_groups)`` — one per
@@ -941,6 +973,7 @@ def run_vectorized_rollout(
             refill_period=refill_period,
             seed_stride=seed_stride,
             telemetry=telemetry,
+            health=health,
             num_valid=num_valid,
             groups=groups,
             num_groups=num_groups,
@@ -1066,6 +1099,14 @@ def run_vectorized_rollout(
                     0 if nf_bad is None else jnp.sum(nf_bad.astype(jnp.int32))
                 ),
             )[None]
+        )
+    if eval_telemetry is not None and health:
+        eval_telemetry = _health_telemetry(
+            eval_telemetry,
+            mean_scores,
+            final.lane_groups if collect_groups else None,
+            num_groups,
+            num_valid,
         )
     return RolloutResult(
         scores=mean_scores,
@@ -1265,6 +1306,7 @@ def _run_refill(
     refill_period,
     seed_stride,
     telemetry=True,
+    health=True,
     num_valid=None,
     groups=None,
     num_groups=1,
@@ -1652,6 +1694,14 @@ def _run_refill(
             )[None],
             final.hist,
         )
+    if eval_telemetry is not None and health:
+        eval_telemetry = _health_telemetry(
+            eval_telemetry,
+            mean_scores,
+            groups_arr if collect_groups else None,
+            num_groups,
+            num_valid,
+        )
     return RolloutResult(
         scores=mean_scores,
         stats=final.stats,
@@ -1675,13 +1725,17 @@ def _compacting_fns(
     compute_dtype,
     stats_sync_axis=None,
     collect_telemetry=True,
+    health=True,
     num_groups=1,
     nonfinite_quarantine=False,
     nonfinite_penalty=None,
     nonfinite_sync_axis=None,
 ):
     """Jitted building blocks of the compacting runner, cached per config so
-    repeated calls (every generation) hit XLA's compile cache."""
+    repeated calls (every generation) hit XLA's compile cache. ``health``
+    appends the v4 search-health block in ``finalize_fn``; the sharded
+    wrapper passes ``health=False`` and appends a mesh-global block itself
+    (``_compacting_sharded_fns``) so the telemetry psum stays exact."""
     num_groups = int(num_groups)
     step = _make_step(
         env,
@@ -1843,6 +1897,14 @@ def _compacting_fns(
                     ),
                 )[None]
             )
+        if telemetry is not None and health:
+            telemetry = _health_telemetry(
+                telemetry,
+                mean_scores,
+                groups_full if num_groups > 1 else None,
+                num_groups,
+                None,  # the compacting runner never pads its buffers
+            )
         return mean_scores, total_episodes, telemetry
 
     return init_fn, chunk_fn, compact_fn, finalize_fn
@@ -1867,6 +1929,7 @@ def run_vectorized_rollout_compacting(
     allowed_widths: Optional[tuple] = None,
     prewarm: bool = False,
     telemetry: bool = True,
+    health: bool = True,
     groups=None,
     num_groups: int = 1,
     nonfinite_quarantine: bool = False,
@@ -1939,6 +2002,7 @@ def run_vectorized_rollout_compacting(
         action_noise_stdev,
         compute_dtype,
         collect_telemetry=bool(telemetry),
+        health=bool(health),
         num_groups=num_groups,
         nonfinite_quarantine=bool(nonfinite_quarantine),
         nonfinite_penalty=nonfinite_penalty,
@@ -2064,6 +2128,7 @@ def _squeeze_shard_scalars(carry: "RolloutCarry") -> "RolloutCarry":
         total_steps=carry.total_steps[0],
         t_global=carry.t_global[0],
         capacity=carry.capacity[0],
+        # graftlint: allow(telemetry-schema): [0] squeezes the leading shard axis, not a wire column
         group_counts=carry.group_counts[0],
     )
 
@@ -2149,6 +2214,7 @@ def _compacting_sharded_fns(
     params_kind: str,
     stats_sync: bool = False,
     collect_telemetry: bool = True,
+    health: bool = True,
     num_groups: int = 1,
     nonfinite_quarantine: bool = False,
     nonfinite_penalty=None,
@@ -2169,6 +2235,11 @@ def _compacting_sharded_fns(
         compute_dtype,
         stats_sync_axis=axis_name if stats_sync else None,
         collect_telemetry=collect_telemetry,
+        # the per-shard finalize must NOT append a health block: the
+        # telemetry psum below would sum the bit-cast float columns across
+        # shards into garbage. sh_finalize_local all_gathers the scores and
+        # appends ONE mesh-global block (shard-0 masked) instead.
+        health=False,
         num_groups=num_groups,
         nonfinite_quarantine=nonfinite_quarantine,
         nonfinite_penalty=nonfinite_penalty,
@@ -2293,6 +2364,27 @@ def _compacting_sharded_fns(
         if telemetry is None:
             telemetry_out = jnp.zeros((0,), dtype=jnp.int32)
         else:
+            if health:
+                # mesh-global search-health block: gather every shard's
+                # final scores into GLOBAL lane order (shards hold
+                # contiguous blocks, so tiled all_gather IS the unsharded
+                # order), compute the identical full-population reduction
+                # on every shard, then zero all but shard 0's copy — the
+                # integer psum below then carries the bit-cast float
+                # columns through exactly (0.0 bit-casts to 0)
+                g_scores = jax.lax.all_gather(
+                    mean_scores, axis_name, tiled=True
+                )
+                g_groups = (
+                    jax.lax.all_gather(groups_shard, axis_name, tiled=True)
+                    if groups_shard is not None
+                    else None
+                )
+                block = compute_health_block(g_scores, g_groups, num_groups)
+                shard0 = (jax.lax.axis_index(axis_name) == 0).astype(
+                    block.dtype
+                )
+                telemetry = append_health_block(telemetry, block * shard0)
             # every slot is additive, so the mesh-global telemetry is one psum
             telemetry_out = jax.lax.psum(telemetry, axis_name)
         if stats_sync:
@@ -2376,6 +2468,7 @@ def run_vectorized_rollout_compacting_sharded(
     return_per_shard_steps: bool = False,
     stats_sync: bool = False,
     telemetry: bool = True,
+    health: bool = True,
     groups=None,
     num_groups: int = 1,
     nonfinite_quarantine: bool = False,
@@ -2435,7 +2528,8 @@ def run_vectorized_rollout_compacting_sharded(
         _params_kind(params_batch),
         bool(stats_sync),
         bool(telemetry),
-        num_groups,
+        health=bool(health),
+        num_groups=num_groups,
         nonfinite_quarantine=bool(nonfinite_quarantine),
         nonfinite_penalty=nonfinite_penalty,
     )

@@ -63,6 +63,7 @@ class VecNE(NEProblem):
         refill_config: Optional[dict] = None,
         solution_groups=None,
         slo=None,
+        health_telemetry: bool = True,
         nonfinite_quarantine: bool = True,
         nonfinite_penalty: Optional[float] = None,
         compute_dtype=None,
@@ -165,6 +166,12 @@ class VecNE(NEProblem):
         self._nonfinite_penalty = (
             None if nonfinite_penalty is None else float(nonfinite_penalty)
         )
+        # search-health plane (docs/observability.md "Search health"): the
+        # compiled eval programs append per-group float32 score statistics
+        # (count/sum/sumsq/min/max) to the telemetry wire — schema v4.
+        # health_telemetry=False compiles the v3 (health-free) programs,
+        # the library form of the BENCH_HEALTH=0 byte-compat escape hatch
+        self._health_telemetry = bool(health_telemetry)
         # SLO watchdog (observability/slo.py): declarative rules evaluated
         # against each generation's decoded telemetry; verdicts surface as
         # slo_ok / slo_violations status keys (logger columns for free)
@@ -391,6 +398,13 @@ class VecNE(NEProblem):
         if self._last_group_telemetry is not None:
             # per-group keys (eval_g{g}_occupancy/...), emitted only at G>1
             status.update(self._last_group_telemetry.as_status(prefix="eval_"))
+            if self._last_group_telemetry.has_health:
+                # search-health plane: previous generation's global score
+                # statistics (per-group keys come from as_status at G>1)
+                stats = self._last_group_telemetry.score_stats()
+                if stats["count"] > 0:
+                    status["eval_score_mean"] = round(stats["mean"], 6)
+                    status["eval_score_std"] = round(stats["std"], 6)
             if self._slo is not None:
                 status.update(
                     self._slo.check(
@@ -416,6 +430,7 @@ class VecNE(NEProblem):
             compute_dtype=self._compute_dtype,
             nonfinite_quarantine=self._nonfinite_quarantine,
             nonfinite_penalty=self._nonfinite_penalty,
+            health=self._health_telemetry,
         )
         if groups is not None:
             # num_groups stays the problem-GLOBAL count: sub-batch matrices
@@ -630,6 +645,7 @@ class VecNE(NEProblem):
                 eval_mode=self._eval_mode,
                 nonfinite_quarantine=self._nonfinite_quarantine,
                 nonfinite_penalty=self._nonfinite_penalty,
+                health=self._health_telemetry,
             )
             if self._eval_mode == "episodes_refill":
                 # explicit knobs pass through GLOBAL (the helper's
@@ -707,6 +723,7 @@ class VecNE(NEProblem):
                 compute_dtype=self._compute_dtype,
                 nonfinite_quarantine=self._nonfinite_quarantine,
                 nonfinite_penalty=self._nonfinite_penalty,
+                health=self._health_telemetry,
                 prewarm=self._take_prewarm(n),
                 stats_sync=(obsnorm and self._obs_norm_sync == "step"),
                 groups=groups,

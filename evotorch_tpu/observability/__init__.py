@@ -7,10 +7,16 @@ Three cooperating pieces (docs/observability.md has the full catalog):
   events and queue-wait lane-steps, accumulated inside the existing
   rollout ``lax.while_loop`` carries and returned as ONE packed int32
   array in the same device->host transfer as the scores. Zero extra
-  dispatches, zero retraces (sentinel-asserted in the fast tier). The v2
-  wire is a PER-GROUP ``(G, 14)`` matrix (segment-summed counters +
-  bucketed queue-wait histograms; ``GroupTelemetry`` decodes it, the v1
-  ``(6,)`` vector still decodes everywhere).
+  dispatches, zero retraces (sentinel-asserted in the fast tier). The v4
+  wire is a PER-GROUP ``(G, 20)`` matrix (segment-summed counters +
+  bucketed queue-wait histograms + the float32 search-health block of
+  score statistics, bit-cast into the int32 rows; ``GroupTelemetry``
+  decodes it, and the v1 ``(6,)`` / v2 ``(G, 14)`` / v3 ``(G, 15)``
+  wires still decode everywhere).
+- :mod:`~evotorch_tpu.observability.health` — windowed, variance-aware
+  trend detection over the health plane (``EWMATrend`` /
+  ``HealthMonitor``), feeding the ``plateau`` / ``stdev_collapse`` /
+  ``score_snr_floor`` SLO rule kinds.
 - :mod:`~evotorch_tpu.observability.tracer` — a host-side span tracer
   emitting Chrome trace-event JSON loadable in Perfetto (ring-buffered;
   a no-op singleton when disabled). Spans cover ask/eval/tell in the
@@ -57,22 +63,29 @@ from .devicemetrics import (  # noqa: F401
     EvalTelemetry,
     GROUP_TELEMETRY_WIDTH,
     GroupTelemetry,
+    HEALTH_TELEMETRY_WIDTH,
+    HEALTH_WIDTH,
     QUEUE_WAIT_BUCKET_EDGES,
     QUEUE_WAIT_BUCKETS,
     TELEMETRY_SCHEMA_VERSION,
     TELEMETRY_WIDTH,
+    append_health_block,
+    compute_health_block,
     pack_eval_telemetry,
     pack_group_telemetry,
     queue_wait_bucket_index,
 )
-# MetricsHub / SLO names resolve lazily (module __getattr__ below): an
-# eager `from .slo import ...` here would trip runpy's double-import
-# warning every time the CLI runs as `python -m evotorch_tpu.observability.slo`
+# MetricsHub / SLO / health names resolve lazily (module __getattr__
+# below): an eager `from .slo import ...` here would trip runpy's
+# double-import warning every time the CLI runs as
+# `python -m evotorch_tpu.observability.slo`
 _LAZY_EXPORTS = {
     "MetricsHub": "metricshub",
     "Rule": "slo",
     "SLOReport": "slo",
     "SLOWatchdog": "slo",
+    "EWMATrend": "health",
+    "HealthMonitor": "health",
 }
 
 
@@ -137,10 +150,14 @@ __all__ = [
     "EvalTelemetry",
     "GroupTelemetry",
     "GROUP_TELEMETRY_WIDTH",
+    "HEALTH_TELEMETRY_WIDTH",
+    "HEALTH_WIDTH",
     "QUEUE_WAIT_BUCKETS",
     "QUEUE_WAIT_BUCKET_EDGES",
     "TELEMETRY_SCHEMA_VERSION",
     "TELEMETRY_WIDTH",
+    "append_health_block",
+    "compute_health_block",
     "pack_eval_telemetry",
     "pack_group_telemetry",
     "queue_wait_bucket_index",
@@ -148,6 +165,8 @@ __all__ = [
     "Rule",
     "SLOReport",
     "SLOWatchdog",
+    "EWMATrend",
+    "HealthMonitor",
     "CounterRegistry",
     "counters",
     "ensure_compile_counter",

@@ -9,17 +9,31 @@ telemetry of an evaluation is a single small device->host transfer of an
 already-materialized output — and every slot is ADDITIVE, so sharded
 evaluations psum it and sub-batched evaluations just add.
 
-Two wire formats share the slot layout:
+Wire formats share the slot layout:
 
 * **v1** — one global ``(TELEMETRY_WIDTH,)`` vector (the PR-8 format;
   ``pack_eval_telemetry`` builds it, :class:`EvalTelemetry` decodes it).
-* **v2** — a per-group ``(G, GROUP_TELEMETRY_WIDTH)`` matrix: the first
+* **v2/v3** — a per-group ``(G, GROUP_TELEMETRY_WIDTH)`` matrix: the first
   ``TELEMETRY_WIDTH`` columns are the v1 slots *per group id*, the
   remaining ``QUEUE_WAIT_BUCKETS`` columns are a log-bucketed queue-wait
   histogram per group (``pack_group_telemetry`` builds it,
   :class:`GroupTelemetry` decodes it; ``TELEMETRY_SCHEMA_VERSION`` names
   the format in metrics manifests). Column-summing the counter block of a
-  v2 matrix reproduces the v1 global numbers exactly.
+  v2 matrix reproduces the v1 global numbers exactly. (v3 added the
+  ``nonfinite`` column to the counter block; v2 wires lift with the
+  column read as 0.)
+* **v4** — the v3 matrix plus a ``HEALTH_WIDTH``-column *search-health
+  plane*: per-group float32 score statistics — ``count, sum, sumsq, min,
+  max`` of the final per-solution mean scores — BIT-CAST to int32 so the
+  whole wire stays one int32 array and rides the existing psum/``__add__``
+  plumbing unchanged (``compute_health_block`` + ``append_health_block``
+  build it; the decoders split and re-view the float block). count/sum/
+  sumsq are Chan-combinable sums; min/max combine by min/max with
+  zero-count rows masked — :meth:`GroupTelemetry.__add__` implements the
+  host-side combiner, and on device the engines compute the block ONCE at
+  program end from the final scores (sliced to the static ``num_valid``
+  so padded and unpadded programs reduce over identical shapes), which is
+  what makes rows bit-identical across mesh shapes.
 
 Slots (column order is the wire format — append only):
 
@@ -69,11 +83,15 @@ from .registry import counters
 __all__ = [
     "TELEMETRY_WIDTH",
     "GROUP_TELEMETRY_WIDTH",
+    "HEALTH_WIDTH",
+    "HEALTH_TELEMETRY_WIDTH",
     "QUEUE_WAIT_BUCKETS",
     "QUEUE_WAIT_BUCKET_EDGES",
     "TELEMETRY_SCHEMA_VERSION",
     "pack_eval_telemetry",
     "pack_group_telemetry",
+    "compute_health_block",
+    "append_health_block",
     "queue_wait_bucket_index",
     "EvalTelemetry",
     "GroupTelemetry",
@@ -100,8 +118,17 @@ QUEUE_WAIT_BUCKETS = len(QUEUE_WAIT_BUCKET_EDGES) + 1
 #: v2 row width: the v1 counter block + the histogram block
 GROUP_TELEMETRY_WIDTH = TELEMETRY_WIDTH + QUEUE_WAIT_BUCKETS
 
+#: v4 search-health plane: per-group float32 score statistics in
+#: combinable form (count/sum/sumsq add; min/max combine by min/max with
+#: empty rows masked), bit-cast to int32 on the wire
+_HEALTH_SLOTS = ("score_count", "score_sum", "score_sumsq", "score_min", "score_max")
+HEALTH_WIDTH = len(_HEALTH_SLOTS)
+
+#: v4 row width: the v3 row + the bit-cast health block
+HEALTH_TELEMETRY_WIDTH = GROUP_TELEMETRY_WIDTH + HEALTH_WIDTH
+
 #: recorded in metrics manifests; bump on any wire-format change
-TELEMETRY_SCHEMA_VERSION = 3
+TELEMETRY_SCHEMA_VERSION = 4
 
 #: pre-quarantine wire widths (schema <= 2: no ``nonfinite`` slot) — still
 #: decoded, with the missing column read as 0, so recorded feeds and the
@@ -174,6 +201,66 @@ def pack_group_telemetry(group_counts, hist=None):
     )
 
 
+def compute_health_block(scores, groups=None, num_groups=1):
+    """The ``(G, HEALTH_WIDTH)`` float32 search-health block (call inside
+    jit, ONCE at program end): per-group ``count, sum, sumsq, min, max`` of
+    the per-solution mean scores. Callers must hand in only the VALID
+    scores (slice to the static ``num_valid`` before calling) so padded
+    and unpadded programs reduce over identical shapes — that, plus
+    computing the block from the final scores rather than accumulating it
+    in the loop carry, is what makes the block bit-identical across mesh
+    shapes. Empty groups read 0 in every slot (min/max are masked by
+    count)."""
+    import jax
+    import jax.numpy as jnp
+
+    scores = jnp.asarray(scores, dtype=jnp.float32)
+    if groups is None:
+        groups = jnp.zeros(scores.shape, dtype=jnp.int32)
+    else:
+        groups = jnp.asarray(groups, dtype=jnp.int32)
+    num_groups = int(num_groups)
+    count = jax.ops.segment_sum(
+        jnp.ones_like(scores), groups, num_segments=num_groups
+    )
+    total = jax.ops.segment_sum(scores, groups, num_segments=num_groups)
+    sumsq = jax.ops.segment_sum(scores * scores, groups, num_segments=num_groups)
+    gmin = jax.ops.segment_min(scores, groups, num_segments=num_groups)
+    gmax = jax.ops.segment_max(scores, groups, num_segments=num_groups)
+    has = count > 0
+    gmin = jnp.where(has, gmin, 0.0)
+    gmax = jnp.where(has, gmax, 0.0)
+    return jnp.stack([count, total, sumsq, gmin, gmax], axis=1)
+
+
+def append_health_block(telemetry, health):
+    """Bit-cast a ``(G, HEALTH_WIDTH)`` float32 health block to int32 and
+    append it to the ``(G, GROUP_TELEMETRY_WIDTH)`` counter matrix,
+    producing the ``(G, HEALTH_TELEMETRY_WIDTH)`` v4 wire (call inside
+    jit). The bit-cast keeps the wire a single int32 array: sharded
+    evaluations zero every shard's block except shard 0 before the psum,
+    so the existing integer psum carries the float bits through exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    as_int = jax.lax.bitcast_convert_type(
+        jnp.asarray(health, dtype=jnp.float32), jnp.int32
+    )
+    return jnp.concatenate(
+        [jnp.asarray(telemetry, dtype=jnp.int32), as_int], axis=1
+    )
+
+
+def _split_health(values: np.ndarray):
+    """Split a host-side v4 ``(G, HEALTH_TELEMETRY_WIDTH)`` matrix into the
+    int64 counter block and the re-viewed float32 health block."""
+    counter = np.asarray(values[:, :GROUP_TELEMETRY_WIDTH], dtype=np.int64)
+    health_bits = np.ascontiguousarray(
+        values[:, GROUP_TELEMETRY_WIDTH:], dtype=np.int32
+    )
+    return counter, health_bits.view(np.float32).astype(np.float64)
+
+
 def queue_wait_bucket_index(waits):
     """Map int32 wait values to histogram bucket indices (inside jit).
     ``sum(wait >= edge)`` over the log-spaced lower edges — branch-free and
@@ -211,14 +298,17 @@ class EvalTelemetry:
         if values.shape == (TELEMETRY_WIDTH,):
             counters.increment("telemetry_fetches")
             return cls(**{name: int(values[i]) for i, name in enumerate(_SLOTS)})
-        if values.ndim == 2 and values.shape[1] == GROUP_TELEMETRY_WIDTH:
+        if values.ndim == 2 and values.shape[1] in (
+            GROUP_TELEMETRY_WIDTH,
+            HEALTH_TELEMETRY_WIDTH,
+        ):
             counters.increment("telemetry_fetches")
             totals = values[:, :TELEMETRY_WIDTH].sum(axis=0)
             return cls(**{name: int(totals[i]) for i, name in enumerate(_SLOTS)})
         raise ValueError(
             f"expected a ({TELEMETRY_WIDTH},) telemetry vector or a"
-            f" (G, {GROUP_TELEMETRY_WIDTH}) per-group matrix, got shape"
-            f" {values.shape}"
+            f" (G, {GROUP_TELEMETRY_WIDTH}) / (G, {HEALTH_TELEMETRY_WIDTH})"
+            f" per-group matrix, got shape {values.shape}"
         )
 
     def __add__(self, other: "EvalTelemetry") -> "EvalTelemetry":
@@ -268,6 +358,12 @@ class GroupTelemetry:
     figures; ``group(g)`` reads one group's counters; the histogram
     quantiles answer "what is this group's tail queue wait" without a
     per-item host transfer.
+
+    A v4 wire additionally carries the bit-cast search-health block;
+    ``health`` holds it re-viewed as a float ``(G, HEALTH_WIDTH)`` matrix
+    (None on pre-v4 wires), ``score_stats`` derives mean/std/min/max, and
+    ``__add__`` combines blocks Chan-style (count/sum/sumsq add, min/max
+    by min/max with empty rows masked).
     """
 
     data: np.ndarray = field(
@@ -275,11 +371,12 @@ class GroupTelemetry:
             (1, GROUP_TELEMETRY_WIDTH), dtype=np.int64
         )
     )
+    health: Optional[np.ndarray] = None
 
     @classmethod
     def from_array(cls, array) -> "GroupTelemetry":
-        """Decode a v2 matrix, or lift a v1 vector into a single-group
-        matrix with empty histogram buckets. Metered like
+        """Decode a v2/v3/v4 matrix, or lift a v1 vector into a
+        single-group matrix with empty histogram buckets. Metered like
         :meth:`EvalTelemetry.from_array`."""
         values = np.asarray(array)
         legacy = _lift_legacy(values)
@@ -290,13 +387,17 @@ class GroupTelemetry:
             row[0, :TELEMETRY_WIDTH] = values
             counters.increment("telemetry_fetches")
             return cls(data=row)
+        if values.ndim == 2 and values.shape[1] == HEALTH_TELEMETRY_WIDTH:
+            counters.increment("telemetry_fetches")
+            counter, health = _split_health(values)
+            return cls(data=counter, health=health)
         if values.ndim == 2 and values.shape[1] == GROUP_TELEMETRY_WIDTH:
             counters.increment("telemetry_fetches")
             return cls(data=np.asarray(values, dtype=np.int64).copy())
         raise ValueError(
-            f"expected a (G, {GROUP_TELEMETRY_WIDTH}) per-group telemetry"
-            f" matrix or a ({TELEMETRY_WIDTH},) v1 vector, got shape"
-            f" {values.shape}"
+            f"expected a (G, {GROUP_TELEMETRY_WIDTH}) or"
+            f" (G, {HEALTH_TELEMETRY_WIDTH}) per-group telemetry matrix or"
+            f" a ({TELEMETRY_WIDTH},) v1 vector, got shape {values.shape}"
         )
 
     @property
@@ -327,15 +428,35 @@ class GroupTelemetry:
         if not isinstance(other, GroupTelemetry):
             return NotImplemented
         a, b = self.data, other.data
+        ha, hb = self.health, other.health
+        g = max(a.shape[0], b.shape[0])
         if a.shape[0] != b.shape[0]:
             # sub-batches may see different group counts; pad to the max
-            g = max(a.shape[0], b.shape[0])
             pa = np.zeros((g, GROUP_TELEMETRY_WIDTH), dtype=np.int64)
             pb = np.zeros((g, GROUP_TELEMETRY_WIDTH), dtype=np.int64)
             pa[: a.shape[0]] = a
             pb[: b.shape[0]] = b
             a, b = pa, pb
-        return GroupTelemetry(data=a + b)
+        health = None
+        if ha is not None and hb is not None:
+            pa = np.zeros((g, HEALTH_WIDTH), dtype=np.float64)
+            pb = np.zeros((g, HEALTH_WIDTH), dtype=np.float64)
+            pa[: ha.shape[0]] = ha
+            pb[: hb.shape[0]] = hb
+            health = pa + pb  # count/sum/sumsq are Chan-combinable sums
+            # min/max: the empty side must not contribute its masked 0
+            a_has, b_has = pa[:, 0] > 0, pb[:, 0] > 0
+            health[:, 3] = np.where(
+                a_has & b_has,
+                np.minimum(pa[:, 3], pb[:, 3]),
+                np.where(a_has, pa[:, 3], pb[:, 3]),
+            )
+            health[:, 4] = np.where(
+                a_has & b_has,
+                np.maximum(pa[:, 4], pb[:, 4]),
+                np.where(a_has, pa[:, 4], pb[:, 4]),
+            )
+        return GroupTelemetry(data=a + b, health=health)
 
     def queue_wait_quantile(
         self, q: float, group: Optional[int] = None
@@ -380,6 +501,40 @@ class GroupTelemetry:
         total = int(hist.sum())
         return (int(hist[-1]) / total) if total else 0.0
 
+    @property
+    def has_health(self) -> bool:
+        """Whether this wire carried the v4 search-health block."""
+        return self.health is not None
+
+    def score_stats(self, group: Optional[int] = None) -> Optional[dict]:
+        """Score statistics derived from the health block — ``count``,
+        ``mean``, ``std`` (population), ``min``, ``max`` — globally or for
+        one group; None on pre-v4 wires, all-zero when nothing scored."""
+        if self.health is None:
+            return None
+        rows = self.health if group is None else self.health[group : group + 1]
+        count = float(rows[:, 0].sum())
+        if count <= 0:
+            return {"count": 0.0, "mean": 0.0, "std": 0.0, "min": 0.0, "max": 0.0}
+        mean = float(rows[:, 1].sum()) / count
+        var = max(float(rows[:, 2].sum()) / count - mean * mean, 0.0)
+        nz = rows[rows[:, 0] > 0]
+        return {
+            "count": count,
+            "mean": mean,
+            "std": var ** 0.5,
+            "min": float(nz[:, 3].min()),
+            "max": float(nz[:, 4].max()),
+        }
+
+    def score_mean(self, group: Optional[int] = None) -> Optional[float]:
+        stats = self.score_stats(group)
+        return None if stats is None else stats["mean"]
+
+    def score_std(self, group: Optional[int] = None) -> Optional[float]:
+        stats = self.score_stats(group)
+        return None if stats is None else stats["std"]
+
     def as_status(self, prefix: str = "eval_") -> dict:
         """Per-group status keys (``{prefix}g{g}_...``) next to the global
         figures — only emitted when there is more than one group, so the
@@ -393,6 +548,10 @@ class GroupTelemetry:
                 out[f"{prefix}g{g}_episodes"] = row.episodes
                 out[f"{prefix}g{g}_queue_wait"] = row.queue_wait
                 out[f"{prefix}g{g}_nonfinite"] = row.nonfinite
+                if self.health is not None:
+                    stats = self.score_stats(g)
+                    out[f"{prefix}g{g}_score_mean"] = round(stats["mean"], 6)
+                    out[f"{prefix}g{g}_score_std"] = round(stats["std"], 6)
         return out
 
     def summary(self) -> str:
@@ -403,6 +562,11 @@ class GroupTelemetry:
                 f"queue_wait_p50={self.queue_wait_quantile(0.5):g}"
                 f" p99={self.queue_wait_quantile(0.99):g}"
             )
+        if self.health is not None:
+            stats = self.score_stats()
+            parts.append(
+                f"score_mean={stats['mean']:g} score_std={stats['std']:g}"
+            )
         return " ".join(parts)
 
     def to_rows(self) -> Tuple[dict, ...]:
@@ -410,12 +574,18 @@ class GroupTelemetry:
         rows = []
         for g in range(self.num_groups):
             row = self.group(g)
-            rows.append(
-                {
-                    "group": g,
-                    **{name: getattr(row, name) for name in _SLOTS},
-                    "occupancy": round(row.occupancy, 6),
-                    "queue_wait_hist": [int(v) for v in self.hist[g]],
-                }
-            )
+            entry = {
+                "group": g,
+                **{name: getattr(row, name) for name in _SLOTS},
+                "occupancy": round(row.occupancy, 6),
+                "queue_wait_hist": [int(v) for v in self.hist[g]],
+            }
+            if self.health is not None:
+                stats = self.score_stats(g)
+                entry["score_count"] = stats["count"]
+                entry["score_mean"] = stats["mean"]
+                entry["score_std"] = stats["std"]
+                entry["score_min"] = stats["min"]
+                entry["score_max"] = stats["max"]
+            rows.append(entry)
         return tuple(rows)

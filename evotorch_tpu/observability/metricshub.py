@@ -50,7 +50,8 @@ __all__ = ["MetricsHub"]
 
 _METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
-#: per-group columns exported as labelled Prometheus series
+#: per-group columns exported as labelled Prometheus series (the score_*
+#: columns appear only on schema-v4 wires carrying the health plane)
 _GROUP_EXPORT_COLS = (
     "env_steps",
     "episodes",
@@ -60,6 +61,11 @@ _GROUP_EXPORT_COLS = (
     "queue_wait",
     "nonfinite",
     "occupancy",
+    "score_count",
+    "score_mean",
+    "score_std",
+    "score_min",
+    "score_max",
 )
 
 
@@ -208,36 +214,76 @@ class MetricsHub:
             "queue_wait_p50": telemetry.queue_wait_quantile(0.5),
             "queue_wait_p99": telemetry.queue_wait_quantile(0.99),
         }
+        if telemetry.has_health:
+            # search-health plane (schema v4): global score statistics
+            stats = telemetry.score_stats()
+            if stats["count"] > 0:
+                fields["score_mean"] = round(stats["mean"], 6)
+                fields["score_std"] = round(stats["std"], 6)
+                fields["score_min"] = round(stats["min"], 6)
+                fields["score_max"] = round(stats["max"], 6)
         if telemetry.num_groups > 1:
             fields["groups"] = telemetry.to_rows()
         return fields
 
     # ------------------------------------------------------------ prometheus
     def _write_prom(self, record: Dict[str, Any]) -> None:
-        lines = [
-            "# evotorch_tpu metrics (textfile-collector format; "
-            f"schema_version={self._manifest['schema_version']})"
-        ]
+        # strict textfile-collector format: every metric family gets its
+        # `# HELP` / `# TYPE` comment pair before its samples (bare samples
+        # trip strict scrapers); labelled per-group series share ONE
+        # family header
+        families: Dict[str, Dict[str, Any]] = {}
+
+        def add(name, sample, *, mtype, help_text):
+            fam = families.setdefault(
+                name, {"type": mtype, "help": help_text, "samples": []}
+            )
+            fam["samples"].append(sample)
+
         for key, value in sorted(record.items()):
             if key == "groups":
                 continue
             if key == "counters" and isinstance(value, dict):
-                for name, cval in sorted(value.items()):
+                for cname, cval in sorted(value.items()):
                     if isinstance(cval, (int, float)) and not isinstance(cval, bool):
-                        lines.append(f"evotorch_counter_{_metric_name(name)} {cval}")
+                        metric = f"evotorch_counter_{_metric_name(cname)}"
+                        add(
+                            metric,
+                            f"{metric} {cval}",
+                            mtype="counter",
+                            help_text=f"process-lifetime counter {cname}",
+                        )
                 continue
             if isinstance(value, bool):
-                lines.append(f"evotorch_{_metric_name(key)} {int(value)}")
-            elif isinstance(value, (int, float)):
-                lines.append(f"evotorch_{_metric_name(key)} {value}")
+                value = int(value)
+            elif not isinstance(value, (int, float)):
+                continue
+            metric = f"evotorch_{_metric_name(key)}"
+            add(
+                metric,
+                f"{metric} {value}",
+                mtype="gauge",
+                help_text=f"per-generation row field {key}",
+            )
         for group_row in record.get("groups", ()):  # labelled per-group series
             gid = group_row.get("group")
             for col in _GROUP_EXPORT_COLS:
                 if col in group_row:
-                    lines.append(
-                        f'evotorch_eval_{_metric_name(col)}{{group="{gid}"}} '
-                        f"{group_row[col]}"
+                    metric = f"evotorch_eval_{_metric_name(col)}"
+                    add(
+                        metric,
+                        f'{metric}{{group="{gid}"}} {group_row[col]}',
+                        mtype="gauge",
+                        help_text=f"per-group telemetry column {col}",
                     )
+        lines = [
+            "# evotorch_tpu metrics (textfile-collector format; "
+            f"schema_version={self._manifest['schema_version']})"
+        ]
+        for name, fam in families.items():
+            lines.append(f"# HELP {name} {fam['help']}")
+            lines.append(f"# TYPE {name} {fam['type']}")
+            lines.extend(fam["samples"])
         tmp = self._path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             fh.write("\n".join(lines))

@@ -34,6 +34,31 @@ contract the multi-tenant eval service and island PBT need:
     here before its quarantined scores distort anyone's ranking; see
     docs/resilience.md.
 
+Three *search-health* kinds read the float32 health plane (schema v4
+score statistics) and the algorithm status keys through the stateful
+:class:`~evotorch_tpu.observability.health.HealthMonitor` the watchdog
+owns (``SLOWatchdog.health``; ``state_dict()``/``load_state_dict()``
+checkpoint the window state):
+
+``plateau``
+    the per-generation score mean (per group, or the global mean when
+    ``group=None``) must keep a statistically significant trend — an EWMA
+    slope gated on the stream's own noise floor
+    (:class:`~evotorch_tpu.observability.health.EWMATrend`). Violation
+    once the no-significant-trend streak reaches ``threshold``
+    generations. Falls back to the ``mean_eval``/``score_mean`` status
+    keys for global rules when the wire has no health plane.
+``stdev_collapse``
+    the ``stdev_norm`` status key must stay >= ``threshold`` x its
+    first-seen baseline (default threshold 0.01): a distribution whose
+    spread imploded by 100x relative to where the run started has stopped
+    exploring. Skipped until the key appears.
+``score_snr_floor``
+    the population score signal-to-noise ratio ``|mean| / std`` must be
+    >= ``threshold`` — per group or global, from the health plane.
+    Skipped when fewer than 2 scores were seen; a zero std (all scores
+    identical) gives infinite SNR and passes.
+
 The watchdog surfaces as searcher status keys (``slo_ok`` /
 ``slo_violations`` / ``slo_detail``) via ``VecNEProblem(slo=...)``, and as
 a battery verdict via the CLI::
@@ -60,6 +85,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Optional, Tuple, Union
 
 from .devicemetrics import GroupTelemetry
+from .health import HealthMonitor
 
 __all__ = [
     "Rule",
@@ -77,6 +103,9 @@ RULE_KINDS = (
     "min_progress",
     "min_model_efficiency",
     "max_nonfinite_share",
+    "plateau",
+    "stdev_collapse",
+    "score_snr_floor",
 )
 
 
@@ -134,15 +163,39 @@ def _coerce_rule(rule: Union[Rule, Dict[str, Any]]) -> Rule:
 
 
 class SLOWatchdog:
-    """Evaluates a fixed rule set against per-group telemetry each call."""
+    """Evaluates a fixed rule set against per-group telemetry each call.
 
-    def __init__(self, rules: Optional[Iterable[Union[Rule, dict]]] = None):
+    The search-health rule kinds (``plateau``, ``stdev_collapse``,
+    ``score_snr_floor``) are *stateful*: the watchdog owns a
+    :class:`~evotorch_tpu.observability.health.HealthMonitor` whose trend
+    windows advance one step per :meth:`check` call.
+    ``state_dict()``/``load_state_dict()`` round-trip that window state so
+    checkpointed runs resume with identical verdict timing.
+    """
+
+    def __init__(
+        self,
+        rules: Optional[Iterable[Union[Rule, dict]]] = None,
+        *,
+        health: Optional[HealthMonitor] = None,
+    ):
         if rules is None or rules is True:
             rules = DEFAULT_RULES
         self.rules: Tuple[Rule, ...] = tuple(_coerce_rule(r) for r in rules)
+        self.health = health if health is not None else HealthMonitor()
 
     def __repr__(self):
         return f"SLOWatchdog(rules={list(self.rules)!r})"
+
+    # --------------------------------------------------------- serialization
+    def state_dict(self) -> Dict[str, Any]:
+        return {"health": self.health.state_dict()}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "SLOWatchdog":
+        health_state = state.get("health")
+        if health_state:
+            self.health.load_state_dict(health_state)
+        return self
 
     # ------------------------------------------------------------ evaluation
     def check(
@@ -198,6 +251,60 @@ class SLOWatchdog:
                     f"nonfinite_share {label}={float(share):.3f} > "
                     f"{rule.threshold:g}"
                 )
+            return False
+        if rule.kind == "stdev_collapse":
+            value = status.get("stdev_norm")
+            if value is None:
+                return None
+            value = float(value)
+            self.health.observe("stdev_norm", value, group=rule.group)
+            baseline = self.health.baseline("stdev_norm", group=rule.group)
+            if baseline is None or baseline <= 0.0:
+                return None
+            if value < rule.threshold * baseline:
+                return (
+                    f"stdev_norm={value:.4g} < {rule.threshold:g} x "
+                    f"baseline {baseline:.4g} (collapse)"
+                )
+            return False
+        if rule.kind == "plateau":
+            # group=None reads the GLOBAL score mean (like
+            # starvation_ceiling's global figure), not every group
+            value = None
+            if telemetry is not None and telemetry.has_health:
+                stats = telemetry.score_stats(group=rule.group)
+                if stats["count"] > 0:
+                    value = stats["mean"]
+            if value is None and rule.group is None:
+                value = status.get("score_mean", status.get("mean_eval"))
+            if value is None:
+                return None
+            trend = self.health.observe(
+                "score_mean", float(value), group=rule.group
+            )
+            if trend.stall_streak >= max(rule.threshold, 1.0):
+                label = "global" if rule.group is None else f"g{rule.group}"
+                return (
+                    f"plateau {label}: no significant score trend for "
+                    f"{trend.stall_streak} generations "
+                    f"(|trend| {abs(trend.delta_ewma):.3g} <= "
+                    f"noise floor {trend.noise_floor:.3g})"
+                )
+            return False
+        if rule.kind == "score_snr_floor":
+            if telemetry is None or not telemetry.has_health:
+                return None
+            stats = telemetry.score_stats(group=rule.group)
+            if stats["count"] < 2:
+                return None
+            snr = (
+                float("inf")
+                if stats["std"] <= 0.0
+                else abs(stats["mean"]) / stats["std"]
+            )
+            if snr < rule.threshold:
+                label = "global" if rule.group is None else f"g{rule.group}"
+                return f"score_snr {label}={snr:.3g} < {rule.threshold:g}"
             return False
         if telemetry is None:
             return None
@@ -255,12 +362,19 @@ DEFAULT_BENCH_RULES: Tuple[Rule, ...] = (
 
 
 # ---------------------------------------------------------------- bench CLI
+def _score_snr(mean: float, std: float) -> float:
+    """|mean| / std; infinite when the spread is exactly zero."""
+    return float("inf") if float(std) <= 0.0 else abs(float(mean)) / float(std)
+
+
 def check_bench_line(
     line: Dict[str, Any],
     *,
     occupancy_floor: float = 0.1,
     min_model_efficiency: Optional[float] = None,
     max_nonfinite_share: Optional[float] = None,
+    max_score_collapse: Optional[float] = None,
+    min_score_snr: Optional[float] = None,
 ) -> SLOReport:
     """Apply the battery rules to one decoded bench.py JSON line.
 
@@ -272,6 +386,14 @@ def check_bench_line(
     produced with BENCH_LEDGER=1) must each clear the floor; a line with
     no ledger columns skips those checks (missing analysis degrades, it
     doesn't fail).
+
+    The health-plane flags read the ``score_mean`` / ``score_std`` columns
+    (present when the line was produced with BENCH_HEALTH=1, the default):
+    ``max_score_collapse`` fails when the score SNR ``|mean| / std``
+    EXCEEDS the ceiling (the population's spread collapsed below 1/T of
+    its mean scale — stdev-collapse seen from the score side);
+    ``min_score_snr`` fails when the SNR is below the floor (the scores
+    are noise-dominated). Lines without the columns skip both.
     """
     violations = []
     checked = 0
@@ -299,6 +421,27 @@ def check_bench_line(
             violations.append(
                 f"model_efficiency={float(eff):.4g} < {min_model_efficiency:g}"
             )
+
+    def _check_health(mean, std, label):
+        nonlocal checked
+        if mean is None or std is None:
+            return
+        snr = _score_snr(mean, std)
+        if max_score_collapse is not None:
+            checked += 1
+            if snr > max_score_collapse:
+                violations.append(
+                    f"{label}score_snr={snr:.3g} > {max_score_collapse:g} "
+                    "(score spread collapsed)"
+                )
+        if min_score_snr is not None:
+            checked += 1
+            if snr < min_score_snr:
+                violations.append(
+                    f"{label}score_snr={snr:.3g} < {min_score_snr:g}"
+                )
+
+    _check_health(line.get("score_mean"), line.get("score_std"), "")
     modes = line.get("modes") or {}
     for mode, rec in sorted(modes.items()):
         if not isinstance(rec, dict):
@@ -318,6 +461,9 @@ def check_bench_line(
                     f"modes.{mode}.model_efficiency={float(meff):.4g} < "
                     f"{min_model_efficiency:g}"
                 )
+        _check_health(
+            rec.get("score_mean"), rec.get("score_std"), f"modes.{mode}."
+        )
     return SLOReport(ok=not violations, violations=tuple(violations), checked=checked)
 
 
@@ -373,6 +519,21 @@ def _main(argv=None) -> int:
         "the population; default: unchecked)",
     )
     parser.add_argument(
+        "--max-score-collapse",
+        type=float,
+        default=None,
+        help="maximum acceptable score SNR |score_mean|/score_std, global "
+        "and per contract — above it the population spread has collapsed "
+        "(default: unchecked; needs a BENCH_HEALTH=1 line)",
+    )
+    parser.add_argument(
+        "--min-score-snr",
+        type=float,
+        default=None,
+        help="minimum acceptable score SNR |score_mean|/score_std — below "
+        "it the scores are noise-dominated (default: unchecked)",
+    )
+    parser.add_argument(
         "--verdict-out",
         metavar="PATH",
         default=None,
@@ -389,6 +550,8 @@ def _main(argv=None) -> int:
             occupancy_floor=args.occupancy_floor,
             min_model_efficiency=args.min_model_efficiency,
             max_nonfinite_share=args.max_nonfinite_share,
+            max_score_collapse=args.max_score_collapse,
+            min_score_snr=args.min_score_snr,
         )
     if report.checked == 0:
         # no decodable line, or a line with none of the checked keys (e.g.

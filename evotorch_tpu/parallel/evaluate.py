@@ -353,6 +353,10 @@ def make_sharded_rollout_evaluator(
         run_vectorized_rollout,
         RolloutResult,
     )
+    from ..observability.devicemetrics import (
+        append_health_block,
+        compute_health_block,
+    )
 
     n_grid = _mesh_grid_size(mesh)
     refill_mode = rollout_kwargs.get("eval_mode") == "episodes_refill"
@@ -373,6 +377,11 @@ def make_sharded_rollout_evaluator(
         # v1 physical-lane accounting
         groups = local_kwargs.pop("groups", None)
         num_groups = int(local_kwargs.pop("num_groups", 1) or 1)
+        groups_valid = (
+            jnp.asarray(groups, dtype=jnp.int32)[:popsize]
+            if groups is not None and num_groups > 1
+            else None
+        )
         if groups is not None and num_groups > 1:
             g = jnp.asarray(groups, dtype=jnp.int32)
             if padded_n != popsize:
@@ -381,6 +390,13 @@ def make_sharded_rollout_evaluator(
                 )
             local_kwargs["groups"] = g
             local_kwargs["num_groups"] = num_groups
+        # the search-health block is computed HERE, not inside the engine:
+        # replicating the final scores first forces every device to run the
+        # identical full-population reduction (no per-shard partial sums),
+        # which is what keeps the float32 stats bit-identical across mesh
+        # shapes (docs/observability.md "Search health")
+        health = bool(local_kwargs.pop("health", True))
+        local_kwargs["health"] = False
 
         def global_eval(values, key, stats):
             if padded_n != popsize:
@@ -399,6 +415,18 @@ def make_sharded_rollout_evaluator(
                 telemetry = jnp.zeros((0,), dtype=jnp.int32)
             else:
                 telemetry = result.telemetry  # the global program's counters
+                if health:
+                    rep = jax.lax.with_sharding_constraint(
+                        result.scores, NamedSharding(mesh, P())
+                    )
+                    telemetry = append_health_block(
+                        telemetry,
+                        compute_health_block(
+                            rep[:popsize],
+                            groups_valid,
+                            num_groups if groups_valid is not None else 1,
+                        ),
+                    )
             return (
                 result.scores[:popsize],
                 result.stats,
@@ -494,6 +522,17 @@ def _shard_map_rollout_evaluator(
     ):
         rollout_kwargs["nonfinite_sync_axis"] = axis_name
 
+    # the per-shard engine must NOT append its own health block — the
+    # telemetry psum below would sum the bit-cast float columns across
+    # shards into garbage; the local fn all_gathers the scores and appends
+    # ONE mesh-global block (shard-0 masked) instead
+    health = bool(rollout_kwargs.pop("health", True))
+    rollout_kwargs["health"] = False
+    from ..observability.devicemetrics import (
+        append_health_block,
+        compute_health_block,
+    )
+
     def build(kind: str, popsize: int):
         # tuned-config cache: cache widths are GLOBAL, divided per shard with
         # the convenience-knob flooring (only an explicit width gets the
@@ -538,9 +577,34 @@ def _shard_map_rollout_evaluator(
             if result.telemetry is None:
                 telemetry = jnp.zeros((0,), dtype=jnp.int32)
             else:
+                telemetry = result.telemetry
+                if health:
+                    # mesh-global health block: gather the final scores into
+                    # GLOBAL lane order (shards hold contiguous blocks, so
+                    # tiled all_gather IS the unsharded order), compute the
+                    # identical full-population reduction on every shard,
+                    # then zero all but shard 0's copy so the integer psum
+                    # carries the bit-cast float columns through exactly
+                    g_scores = jax.lax.all_gather(
+                        result.scores, axis_name, tiled=True
+                    )
+                    g_groups = (
+                        jax.lax.all_gather(groups_shard, axis_name, tiled=True)
+                        if groups_shard is not None
+                        else None
+                    )
+                    block = compute_health_block(
+                        g_scores,
+                        g_groups,
+                        num_groups if groups_shard is not None else 1,
+                    )
+                    shard0 = (jax.lax.axis_index(axis_name) == 0).astype(
+                        block.dtype
+                    )
+                    telemetry = append_health_block(telemetry, block * shard0)
                 # all telemetry slots are additive: the mesh-global
                 # observability vector is one psum, in the same program
-                telemetry = jax.lax.psum(result.telemetry, axis_name)
+                telemetry = jax.lax.psum(telemetry, axis_name)
             return (
                 result.scores,
                 merged,
@@ -627,6 +691,10 @@ def make_generation_step(
     the old state's buffers are invalidated.
     """
     from ..neuroevolution.net.vecrl import run_vectorized_rollout
+    from ..observability.devicemetrics import (
+        append_health_block,
+        compute_health_block,
+    )
 
     _check_reserved(rollout_kwargs, "make_generation_step")
     if mesh is None:
@@ -640,12 +708,21 @@ def make_generation_step(
     # make_sharded_rollout_evaluator)
     groups = rollout_kwargs.pop("groups", None)
     num_groups = int(rollout_kwargs.pop("num_groups", 1) or 1)
+    groups_valid = (
+        jnp.asarray(groups, dtype=jnp.int32)[:popsize]
+        if groups is not None and num_groups > 1
+        else None
+    )
     if groups is not None and num_groups > 1:
         g = jnp.asarray(groups, dtype=jnp.int32)
         if padded_n != popsize:
             g = jnp.concatenate([g, jnp.broadcast_to(g[:1], (padded_n - popsize,))])
         rollout_kwargs["groups"] = g
         rollout_kwargs["num_groups"] = num_groups
+    # health block computed on replicated scores, like
+    # make_sharded_rollout_evaluator (mesh-shape bit-identity)
+    health = bool(rollout_kwargs.pop("health", True))
+    rollout_kwargs["health"] = False
 
     def generation(state, key, stats):
         k_ask, k_eval = jax.random.split(key)
@@ -663,11 +740,22 @@ def make_generation_step(
         )
         scores = result.scores[:popsize]
         new_state = tell(state, values, scores)
-        telemetry = (
-            jnp.zeros((0,), dtype=jnp.int32)
-            if result.telemetry is None
-            else result.telemetry
-        )
+        if result.telemetry is None:
+            telemetry = jnp.zeros((0,), dtype=jnp.int32)
+        else:
+            telemetry = result.telemetry
+            if health:
+                rep = jax.lax.with_sharding_constraint(
+                    result.scores, NamedSharding(mesh, P())
+                )
+                telemetry = append_health_block(
+                    telemetry,
+                    compute_health_block(
+                        rep[:popsize],
+                        groups_valid,
+                        num_groups if groups_valid is not None else 1,
+                    ),
+                )
         return new_state, scores, result.stats, result.total_steps, telemetry
 
     return jax.jit(generation, donate_argnums=(0,) if donate_state else ())

@@ -68,8 +68,14 @@ run bench_bf16 1800 env BENCH_BF16=1 python bench.py
 # --min-model-efficiency is a LOOSE sanity floor (an order-of-magnitude
 # collapse of the MFU column, not a tight target — the flagship 64x64
 # policy is inherently low-MFU; docs/policies.md has the wide-policy story)
+# --max-score-collapse is the search-health hook (docs/observability.md
+# "Search health"): a near-zero score spread across a popsize-10k
+# generation means the eval distribution degenerated (the score-side
+# stdev-collapse signal), loose enough that a healthy flagship line never
+# trips it
 run slo_check 300 python -m evotorch_tpu.observability.slo \
   --check-bench "$OUT/bench_f32.log" --min-model-efficiency 1e-5 \
+  --max-score-collapse 1e6 \
   --verdict-out "$OUT/slo_verdict.txt"
 
 # 2. the MXU claim: wide policy dense vs low-rank (budget contract isolates

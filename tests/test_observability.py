@@ -190,7 +190,7 @@ def test_refill_queue_wait_counts_gated_idle_lanes():
 
 
 # ---------------------------------------------------------------------------
-# per-group telemetry (the v2 (G, 14) wire)
+# per-group telemetry (the per-group counter wire; health block in test_health.py)
 # ---------------------------------------------------------------------------
 
 
@@ -205,7 +205,7 @@ def _group_matrix():
 
 
 def test_group_telemetry_decode_total_and_quantiles():
-    assert TELEMETRY_SCHEMA_VERSION == 3
+    assert TELEMETRY_SCHEMA_VERSION == 4
     gt = GroupTelemetry.from_array(_group_matrix())
     assert gt.num_groups == 2
     assert gt.hist.shape == (2, QUEUE_WAIT_BUCKETS)
@@ -448,9 +448,47 @@ def test_metricshub_prometheus_rewrite(tmp_path):
     assert 'evotorch_eval_occupancy{group="1"}' in text
     assert "evotorch_gen 7" in text
     # full rewrite, not append: a second emit leaves ONE copy of each series
+    # (count SAMPLE lines — the HELP/TYPE headers also name the metric)
     hub.emit({"gen": 8}, telemetry=gt)
-    text = path.read_text()
-    assert text.count("evotorch_gen ") == 1 and "evotorch_gen 8" in text
+    rows = [l for l in path.read_text().splitlines() if l.startswith("evotorch_gen ")]
+    assert rows == ["evotorch_gen 8"]
+
+
+def test_metricshub_prometheus_help_and_type(tmp_path):
+    # textfile-collector contract: every exported metric family carries a
+    # `# HELP` and a `# TYPE` header, exactly once, BEFORE its samples;
+    # registry counters are typed `counter`, everything else `gauge`
+    gt = GroupTelemetry.from_array(_group_matrix())
+    path = tmp_path / "metrics.prom"
+    hub = MetricsHub(str(path))
+    hub.emit({"gen": 7, "mean_eval": 1.25}, telemetry=gt)
+    lines = path.read_text().splitlines()
+    helps, types, samples = {}, {}, {}
+    for i, line in enumerate(lines):
+        if line.startswith("# HELP "):
+            helps[line.split()[2]] = i
+        elif line.startswith("# TYPE "):
+            _, _, name, mtype = line.split()
+            types[name] = (i, mtype)
+        elif line and not line.startswith("#"):
+            name = line.split("{")[0].split()[0]
+            samples.setdefault(name, i)
+    assert samples, lines
+    for name, first in samples.items():
+        assert name in helps, f"no HELP for {name}"
+        assert name in types, f"no TYPE for {name}"
+        assert helps[name] < types[name][0] < first
+    assert types["evotorch_gen"][1] == "gauge"
+    # the per-group family shares ONE header over its labelled samples
+    assert "evotorch_eval_occupancy" in types
+    grouped = [l for l in lines if l.startswith("evotorch_eval_occupancy{")]
+    assert len(grouped) == 2
+    assert sum(l.startswith("# TYPE evotorch_eval_occupancy ") for l in lines) == 1
+    # registry counters (when present) are typed counter
+    counter_types = {
+        mtype for _, mtype in types.values()
+    }
+    assert counter_types <= {"gauge", "counter"}
 
 
 # ---------------------------------------------------------------------------
