@@ -477,6 +477,125 @@ def main():
             "trunk_ab_mode": ab_mode,
         }
 
+    span_ab = {}
+    span_record = None
+    if cfg["span"] is not None:
+        # BENCH_SPAN: the fused-span headline A/B — K generations scanned
+        # into ONE donated GSPMD program (parallel.make_training_span) vs
+        # the SAME generation body dispatched K times from the host loop
+        # (parallel.make_generation_step, same default mesh), on the primary
+        # contract (budget when the primary is the host-orchestrated compact
+        # runner, which cannot be fused). INTERLEAVED median-of-N samples of
+        # one span each (BENCH_SPAN_AB_REPEATS, default 3); both programs
+        # warm up TWICE before the clock — with donation the first call
+        # compiles the fresh-layout program and the second the steady-state
+        # layout-committed one — and every timed loop runs under the retrace
+        # sentinel.
+        from bench_common import tuned_span
+        from evotorch_tpu.parallel import (
+            default_mesh,
+            make_generation_step,
+            make_training_span,
+        )
+
+        span_k, span_src = tuned_span(cfg, params=policy.parameter_count)
+        span_ab_mode = eval_mode if eval_mode != "episodes_compact" else "budget"
+        span_kwargs = dict(rollout_kwargs)
+        span_kwargs["eval_mode"] = span_ab_mode
+        if span_ab_mode == "episodes_refill":
+            span_kwargs.update(refill_cfg)
+        if trunk_delta:
+            span_kwargs["trunk_block"] = trunk_cfg["trunk_block"]
+        span_mesh = default_mesh(("pop",))
+
+        def span_ask(k, s):
+            return ask(k, s, popsize=popsize)
+
+        gen_step = make_generation_step(
+            env, policy, ask=span_ask, tell=tell, popsize=popsize,
+            mesh=span_mesh, **span_kwargs,
+        )
+        span_fn = make_training_span(
+            env, policy, ask=span_ask, tell=tell, popsize=popsize,
+            span=span_k, mesh=span_mesh, **span_kwargs,
+        )
+        ab_stats = RunningNorm(env.observation_size).stats
+
+        def host_sample(state, key):
+            steps_total = 0
+            out = None
+            for _ in range(span_k):
+                key, sub = jax.random.split(key)
+                state, scores, _, steps, _ = gen_step(state, sub, ab_stats)
+                steps_total += int(steps)
+                out = scores
+            jax.block_until_ready(out)
+            return state, key, steps_total
+
+        def span_sample(state, key):
+            key, sub = jax.random.split(key)
+            state, scores, _, steps, _ = span_fn(
+                state, jax.random.split(sub, span_k), ab_stats
+            )
+            jax.block_until_ready(scores)
+            return state, key, int(steps.sum())
+
+        span_runs = {}
+        for leg, sampler in (("hostloop", host_sample), ("span", span_sample)):
+            st = fresh_pgpe_state(policy.parameter_count)
+            key, leg_key = jax.random.split(key)
+            st, leg_key, _ = sampler(st, leg_key)  # compile (fresh layout)
+            st, leg_key, _ = sampler(st, leg_key)  # steady-state layout
+            span_runs[leg] = {
+                "sampler": sampler, "state": st, "key": leg_key, "samples": [],
+            }
+        for _ in range(cfg["span_ab_repeats"]):
+            for leg, run in span_runs.items():
+                with track_compiles() as compile_log:
+                    t0 = time.perf_counter()
+                    run["state"], run["key"], sample_steps = run["sampler"](
+                        run["state"], run["key"]
+                    )
+                    elapsed = time.perf_counter() - t0
+                steady_compiles += compile_log.count
+                run["samples"].append(sample_steps / elapsed)
+                run["steps"] = sample_steps
+        med_span = {
+            leg: statistics.median(r["samples"]) for leg, r in span_runs.items()
+        }
+        print(
+            f"[span_ab/{span_ab_mode}] span={span_k}, "
+            f"{cfg['span_ab_repeats']} interleaved samples: hostloop "
+            f"{med_span['hostloop']:.0f} vs span {med_span['span']:.0f} "
+            f"steps/s ({med_span['span'] / med_span['hostloop']:.2f}x)",
+            file=sys.stderr,
+        )
+        span_ab = {
+            "span": span_k,
+            "span_speedup": round(med_span["span"] / med_span["hostloop"], 3),
+            "span_value": round(med_span["span"], 1),
+            "hostloop_value": round(med_span["hostloop"], 1),
+            "span_ab_mode": span_ab_mode,
+        }
+        if cfg["tuned"]:
+            span_ab["span_config_source"] = span_src
+        if cfg["ledger"]:
+            # AOT-capture the span program itself (outside every timed
+            # region; the key array must be concrete — lowering folds it)
+            span_record = program_ledger.capture(
+                "bench.training_span",
+                span_fn,
+                abstract_like(fresh_pgpe_state(policy.parameter_count)),
+                jax.random.split(jax.random.key(0), span_k),
+                abstract_like(ab_stats),
+                shape={
+                    "env": cfg["env_name"],
+                    "popsize": popsize,
+                    "episode_length": episode_length,
+                    "span": span_k,
+                },
+            )
+
     primary = modes[eval_mode]
     # the episodes-contract headline is the best runner of that contract
     episodes_runners = [
@@ -561,6 +680,20 @@ def main():
         line["trunk_block"] = trunk_cfg["trunk_block"]
         if cfg["tuned"]:
             line["trunk_config_source"] = trunk_src
+    if cfg["span"] is not None:
+        # BENCH_SPAN only: the fused-span A/B columns (absent by default,
+        # so the default line stays byte-compatible with PR-18 output)
+        line.update(span_ab)
+        if span_record is not None:
+            # the span program's own ledger figures: its cost-model FLOPs
+            # cover the WHOLE K-generation scan, so the per-step
+            # denominator is the span's counted env-steps
+            line["span_program"] = ledger_columns(
+                span_record,
+                steps_per_sec=span_ab["span_value"],
+                steps_per_generation=span_runs["span"].get("steps"),
+                param_count=policy.parameter_count,
+            )
     if cfg["ledger"]:
         # the primary contract's program-ledger figures, hoisted next to
         # `value` (per-contract copies live inside `modes`); absent entirely

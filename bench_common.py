@@ -114,6 +114,17 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
             if "BENCH_TRUNK_BLOCK" in os.environ
             else None
         ),
+        # BENCH_SPAN=K fuses K generations per device dispatch
+        # (parallel.make_training_span — ask→eval→tell scanned into ONE
+        # donated program) and runs the in-process interleaved span-vs-
+        # host-loop A/B (`span_speedup` on the line); "auto" consults the
+        # tuned-config cache's `span` group (the `--group span` autotuner
+        # winner, fallback 8). Unset = no span measurement, line
+        # byte-compatible. episodes_compact is host-orchestrated and cannot
+        # be fused; its span A/B runs on the budget contract instead
+        # (`span_ab_mode` says which contract was measured).
+        "span": os.environ.get("BENCH_SPAN"),
+        "span_ab_repeats": int(os.environ.get("BENCH_SPAN_AB_REPEATS", "3")),
         "env_name": os.environ.get("BENCH_ENV", "humanoid"),
         "env_kwargs": json.loads(os.environ.get("BENCH_ENV_ARGS", "{}")),
         # lane-compaction tuning (episodes_compact only): chunk size between
@@ -283,6 +294,25 @@ def tuned_policy(cfg: dict, *, params=None, mesh_label: str = "none"):
     }, source
 
 
+def tuned_span(cfg: dict, *, params=None, mesh_label: str = "none"):
+    """The fused-span length K + ``tuned_config_source`` provenance —
+    same precedence and cache key as the schedule knobs, under the
+    autotuner's ``span`` group (observability/autotune.py ``SpanHarness``).
+    ``BENCH_SPAN=K`` overrides; ``BENCH_SPAN=auto`` consults the cache;
+    fallback 8 (the acceptance shape's measured sweet spot)."""
+    from evotorch_tpu.observability.timings import resolve_knobs
+
+    raw = cfg["span"]
+    explicit = {"span": None if raw in (None, "auto") else int(raw)}
+    config, source = resolve_knobs(
+        explicit,
+        "span",
+        _tuned_shape(cfg, params, mesh_label),
+        use_cache=_use_tuned_cache(cfg, params),
+    )
+    return max(1, int(config.get("span") or 8)), source
+
+
 def bench_hidden() -> list:
     """The BENCH_HIDDEN layer widths as a list of ints (default ``[64, 64]``)
     — also the ``hidden`` column bench.py stamps on ledger-carrying lines so
@@ -317,7 +347,6 @@ def measure_mujoco(cfg: dict) -> dict:
     import time
 
     import gymnasium as gym
-    import jax.numpy as jnp
     import numpy as np
 
     from evotorch_tpu.envs.mujoco.mjvecenv import MjVecEnv
@@ -339,9 +368,10 @@ def measure_mujoco(cfg: dict) -> dict:
     probe.close()
     policy = FlatParamsPolicy(_bench_mlp(obs_dim, act_dim))
     rng = np.random.default_rng(0)
-    params = jnp.asarray(
-        rng.normal(size=(popsize, policy.parameter_count)), jnp.float32
-    )
+    # numpy, NOT jnp: the rollout loops slice this matrix right before every
+    # jitted forward dispatch, and a numpy argument is ~3x cheaper per
+    # dispatch than a committed device array on this jax (CLAUDE.md r7 note)
+    params = rng.normal(size=(popsize, policy.parameter_count)).astype(np.float32)
 
     def fresh_vec():
         vec = MjVecEnv(lambda: gym.make(env_id), num_envs)

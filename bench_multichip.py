@@ -161,7 +161,10 @@ def main():
             cfg, params=policy.parameter_count, mesh_label=mesh_label_of(mesh)
         )
 
-    def build_gspmd():
+    def gspmd_recipe():
+        """The GSPMD ask/tell callables + rollout knobs, shared by the
+        per-generation program and the fused-span program so the two legs
+        of the BENCH_SPAN A/B cannot silently diverge."""
         nonlocal refill_src
         rkw = {}
         if eval_mode == "episodes_refill":
@@ -186,6 +189,10 @@ def main():
                 return pgpe_ask(k, s, popsize=popsize)
 
             tell_fn = pgpe_tell
+        return ask_fn, tell_fn, rkw
+
+    def build_gspmd():
+        ask_fn, tell_fn, rkw = gspmd_recipe()
         step = make_generation_step(
             env,
             policy,
@@ -422,6 +429,92 @@ def main():
             file=sys.stderr,
         )
 
+    span_ab = {}
+    if cfg["span"] is not None and "gspmd" in variants:
+        # BENCH_SPAN on the sharded path: K generations of the SAME GSPMD
+        # recipe scanned into one donated program (parallel.make_training_span
+        # at THIS mesh) vs the per-generation program dispatched K times from
+        # the host loop — interleaved median-of-N samples of one span each.
+        # Absent for episodes_compact (host-orchestrated, cannot be fused)
+        # and for the legacy shard_map-only runs.
+        from bench_common import tuned_span
+        from evotorch_tpu.parallel import make_training_span
+
+        span_k, span_src = tuned_span(
+            cfg, params=policy.parameter_count, mesh_label=mesh_label_of(mesh)
+        )
+        ask_fn, tell_fn, rkw = gspmd_recipe()
+        span_fn = make_training_span(
+            env,
+            policy,
+            ask=ask_fn,
+            tell=tell_fn,
+            popsize=popsize,
+            span=span_k,
+            mesh=mesh,
+            num_episodes=1,
+            episode_length=episode_length,
+            compute_dtype=compute_dtype,
+            eval_mode=eval_mode,
+            **rkw,
+        )
+        # two warmups (fresh layout, then the steady-state layout-committed
+        # program under donation); the hostloop leg reuses the gspmd
+        # generation already at ITS layout fixed point from the loop above
+        sp_state, sp_stats = fresh_pgpe_state(policy.parameter_count), stats0
+        for _ in range(2):
+            key, sub = jax.random.split(key)
+            sp_state, scores, sp_stats, steps, _ = span_fn(
+                sp_state, jax.random.split(sub, span_k), sp_stats
+            )
+            jax.block_until_ready(scores)
+        host_gen = runs["gspmd"]["gen"]
+        hl_state, hl_stats = runs["gspmd"]["state"], runs["gspmd"]["stats"]
+        span_samples = {"hostloop": [], "span": []}
+        for _ in range(cfg["span_ab_repeats"]):
+            with track_compiles() as compile_log:
+                t0 = time.perf_counter()
+                sample_steps = 0
+                for _ in range(span_k):
+                    key, sub = jax.random.split(key)
+                    hl_state, hl_stats, per_shard, scores = host_gen(
+                        hl_state, sub, hl_stats
+                    )
+                    jax.block_until_ready(scores)
+                    sample_steps += int(np.sum(np.asarray(per_shard)))
+                span_samples["hostloop"].append(
+                    sample_steps / (time.perf_counter() - t0)
+                )
+            steady_compiles += compile_log.count
+            with track_compiles() as compile_log:
+                t0 = time.perf_counter()
+                key, sub = jax.random.split(key)
+                sp_state, scores, sp_stats, steps, _ = span_fn(
+                    sp_state, jax.random.split(sub, span_k), sp_stats
+                )
+                jax.block_until_ready(scores)
+                span_samples["span"].append(
+                    int(np.sum(np.asarray(steps))) / (time.perf_counter() - t0)
+                )
+            steady_compiles += compile_log.count
+        med_hl = statistics.median(span_samples["hostloop"])
+        med_sp = statistics.median(span_samples["span"])
+        print(
+            f"[span_ab/{eval_mode}] span={span_k}, "
+            f"{cfg['span_ab_repeats']} interleaved samples: hostloop "
+            f"{med_hl:.0f} vs span {med_sp:.0f} steps/s "
+            f"({med_sp / med_hl:.2f}x)",
+            file=sys.stderr,
+        )
+        span_ab = {
+            "span": span_k,
+            "span_speedup": round(med_sp / med_hl, 3),
+            "span_value": round(med_sp, 1),
+            "hostloop_value": round(med_hl, 1),
+        }
+        if cfg["tuned"]:
+            span_ab["span_config_source"] = span_src
+
     primary = variants[0]
     steps_per_sec = medians[primary]
     record = records.get(primary)
@@ -477,6 +570,9 @@ def main():
         line["trunk_block"] = trunk_cfg["trunk_block"]
         if cfg["tuned"]:
             line["trunk_config_source"] = trunk_src
+    if span_ab:
+        # BENCH_SPAN only (default line stays byte-compatible)
+        line.update(span_ab)
     if spmd == "ab":
         line["spmd_speedup"] = round(medians["gspmd"] / medians["shard_map"], 3)
         line["shard_map_value"] = round(medians["shard_map"], 1)

@@ -27,3 +27,31 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Persistent XLA compile cache for the suite (EVOTORCH_TEST_COMPILE_CACHE=0
+# opts out). The fast tier is compile-dominated on this 1-core box — the
+# same GSPMD programs are rebuilt module after module, and the suite
+# outgrew its tier-1 budget on compile time alone. Entries are keyed on
+# HLO + compile options, so the 8-virtual-device test programs never
+# collide with bench/TPU entries; the warm-process acceptance test
+# (test_gspmd.py) runs in subprocesses with its own tmp dir and never
+# sees this cache. Retrace sentinels count at the lowering layer, so a
+# disk hit still registers as a compile and steady-state zero-counts are
+# unaffected; the ledger gate bands flops/peak_bytes, not compile time
+# (and its capture fixture bypasses the cache — deserialized executables
+# report +1408 bytes of peak memory on this backend). One behavioral
+# difference a warm run DOES have: a deserialized donated program may write
+# outputs in place into the donated input buffer, so numpy VIEWS of
+# to-be-donated arrays (np.asarray without .copy()) mutate — snapshot with
+# an explicit copy (see test_trunk_delta.py's center_before).
+if os.environ.get("EVOTORCH_TEST_COMPILE_CACHE", "1") != "0":
+    from evotorch_tpu.observability import enable_persistent_cache
+
+    enable_persistent_cache(
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "compile_cache",
+            "tests",
+        ),
+        xla_caches=False,
+    )

@@ -23,6 +23,7 @@ from .funcpgpe import (
     pgpe_tell_trunk_delta,
 )
 from .funcsnes import SNESState, snes, snes_ask, snes_tell
+from .span import make_search_span
 from .funcxnes import XNESState, xnes, xnes_ask, xnes_tell
 from .funcsgd import SGDState, sgd, sgd_ask, sgd_tell
 from .misc import OptimizerFunctions, get_functional_optimizer
@@ -62,6 +63,7 @@ __all__ = [
     "pgpe_ask_trunk_delta",
     "pgpe_tell_trunk_delta",
     "pgpe_health",
+    "make_search_span",
     "SNESState",
     "snes",
     "snes_ask",

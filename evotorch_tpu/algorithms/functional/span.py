@@ -1,0 +1,59 @@
+"""``make_search_span``: K ask->fitness->tell generations scanned into one
+jitted, state-donating program.
+
+The functional-searcher counterpart of ``parallel.make_training_span`` for
+objectives that are plain jax functions (no rollout engine): the ONE
+scanned-generations idiom in the repo — ``examples/functional_batched_search``
+and the program-ledger's batched-search gate program are both built on it.
+Because every functional searcher state is a pytree and ``ask``/``tell`` are
+pure, the helper composes with ``jax.vmap`` for batched searches exactly like
+a hand-rolled scan would (evosax-style ES batteries, PAPERS.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["make_search_span"]
+
+
+def make_search_span(
+    fitness: Callable,
+    *,
+    ask: Callable,
+    tell: Callable,
+    metrics: Optional[Callable] = None,
+    donate_state: bool = True,
+):
+    """Fuse K generations of a functional searcher into one donated program.
+
+    ``ask(key, state) -> population`` (bind popsize et al. with
+    ``functools.partial``), ``fitness(population) -> evals`` and
+    ``tell(state, population, evals) -> state`` are scanned ``len(keys)``
+    times; ``metrics(population, evals) -> pytree`` (default: the raw evals)
+    picks what is stacked per generation as the scan ys.
+
+    Returns ``span_fn(state, keys) -> (state, ys)`` — jitted with the state
+    donated (``donate_state=False`` opts out, e.g. when the caller reuses the
+    initial state for an A/B). ``keys`` is a ``(K,)`` PRNG key array, one per
+    generation; resume-friendly callers derive them from absolute generation
+    indices (``jax.random.fold_in``) so a restarted run replays the identical
+    stream. Bit-identity with a hand-rolled ``lax.scan`` over the same body
+    holds by construction (same trace); K separately-jitted sequential calls
+    agree numerically but XLA may reassociate float reductions across the
+    per-call program boundaries.
+    """
+
+    def generation(state, key):
+        population = ask(key, state)
+        evals = fitness(population)
+        new_state = tell(state, population, evals)
+        out = evals if metrics is None else metrics(population, evals)
+        return new_state, out
+
+    def span_fn(state, keys):
+        return jax.lax.scan(generation, state, keys)
+
+    return jax.jit(span_fn, donate_argnums=(0,) if donate_state else ())

@@ -366,8 +366,21 @@ class VecNE(NEProblem):
 
     def _consume_telemetry(self, telemetry):
         """Enqueue this evaluation's packed telemetry vector and decode the
-        previous one (already materialized — see the constructor note)."""
+        previous one (already materialized — see the constructor note).
+
+        A STACKED ``(K, G, C)`` matrix from a fused training span feeds the
+        same swap row by row: by the time the span's host fetch happens the
+        whole program has retired, so rows ``0..K-2`` decode immediately and
+        only the FINAL row stays pending until the next consume — the
+        lag-by-one discipline generalized to lag-by-span (docs/observability.md
+        "Lag-by-span")."""
         if telemetry is None:
+            return
+        if getattr(telemetry, "ndim", 0) == 3:
+            if telemetry.shape[-1] == 0:  # graftlint: allow(telemetry-schema): width-0 emptiness probe on .shape, not a column read
+                return  # stacked telemetry-off wire
+            for row in telemetry:
+                self._consume_telemetry(row)
             return
         from ..observability import GroupTelemetry
 
@@ -751,6 +764,99 @@ class VecNE(NEProblem):
         self._consume_telemetry(result.telemetry)
         batch.set_evals(self._maybe_inject_nonfinite(result.scores))
         self.update_status(self._report_counters(batch))
+
+    # --------------------------------------------- fused training spans ---
+    def make_training_span(
+        self,
+        *,
+        ask,
+        tell,
+        popsize: int,
+        span: int,
+        mesh=None,
+        donate_state: bool = True,
+        state_metrics=None,
+    ):
+        """A fused K-generation training program for THIS problem
+        (``parallel.make_training_span``): ``lax.scan`` over ``span``
+        generations of ask → eval → tell in ONE donated GSPMD program,
+        carrying the problem's full eval configuration — contract, episode
+        shape, obs-norm, quarantine, per-group ids, health telemetry, and
+        (for ``episodes_refill``) the tuned/explicit refill knobs resolved
+        exactly as the per-generation path resolves them.
+
+        ``ask``/``tell`` are functional-API callables (the OO searcher shells
+        hold host state and cannot ride inside the scan). Feed each result
+        back through :meth:`consume_span` so the interaction counters, the
+        telemetry swap (lag-by-span) and the obs-norm stats keep flowing into
+        the status keys. The host-orchestrated ``episodes_compact`` contract
+        cannot be fused — the builder raises."""
+        from ..parallel.evaluate import make_training_span as _make_span
+
+        popsize = int(popsize)
+        kwargs = dict(
+            num_episodes=self._num_episodes,
+            episode_length=self._episode_length,
+            observation_normalization=self._observation_normalization,
+            alive_bonus_schedule=self._alive_bonus_schedule,
+            decrease_rewards_by=self._decrease_rewards_by,
+            action_noise_stdev=self._action_noise_stdev,
+            compute_dtype=self._compute_dtype,
+            nonfinite_quarantine=self._nonfinite_quarantine,
+            nonfinite_penalty=self._nonfinite_penalty,
+            health=self._health_telemetry,
+            eval_mode=self._eval_mode,
+        )
+        if self._eval_mode == "episodes_refill":
+            kwargs.update(self._refill_kwargs(popsize))
+        groups = self._check_solution_groups(popsize)
+        if groups is not None:
+            kwargs["groups"] = groups
+            kwargs["num_groups"] = self._num_groups
+        return _make_span(
+            self._env,
+            self._policy,
+            ask=ask,
+            tell=tell,
+            popsize=popsize,
+            span=span,
+            mesh=mesh,
+            donate_state=donate_state,
+            state_metrics=state_metrics,
+            **kwargs,
+        )
+
+    def consume_span(self, result):
+        """Feed one :meth:`make_training_span` result back into the
+        problem's host-side accounting: obs-norm statistics, the device-
+        scalar interaction/episode counters (the per-generation step counts
+        sum ON DEVICE; episodes come from the stacked telemetry's episodes
+        column via ``device_episode_total`` — also on device — with a
+        host-arithmetic fallback when telemetry is off), and the telemetry
+        swap (rows 0..K-2 decode now, the final row stays pending —
+        lag-by-span). Returns the stacked ``(span, popsize)`` scores."""
+        state, scores, stats, total_steps, telemetry = result[:5]
+        if self._observation_normalization:
+            self._obs_norm.stats = stats
+        span = int(scores.shape[0])
+        if telemetry is not None and getattr(telemetry, "size", 0):
+            from ..observability.devicemetrics import device_episode_total
+
+            episodes = device_episode_total(telemetry)
+        elif self._eval_mode == "budget":
+            episodes = 0  # auto-reset episode counts live only in telemetry
+        else:
+            episodes = int(scores.shape[-1]) * self._num_episodes * span
+        self._bump_counters(
+            total_steps.sum() if hasattr(total_steps, "sum") else sum(total_steps),
+            episodes,
+        )
+        self._consume_telemetry(telemetry)
+        # refresh the status keys; _report_counters only reads len() of its
+        # argument (the nonfinite-share denominator), so the final
+        # generation's score row stands in for the batch
+        self.update_status(self._report_counters(scores[-1]))
+        return scores
 
 
 # the reference's class name, for drop-in familiarity

@@ -87,6 +87,7 @@ __all__ = [
     "PolicyHarness",
     "RefillHarness",
     "SearchOutcome",
+    "SpanHarness",
     "analytic_prune",
     "autotune_search",
     "candidate_grid",
@@ -1035,6 +1036,235 @@ class PolicyHarness(_BespokeHarness):
         }
 
 
+class SpanHarness(_BespokeHarness):
+    """Tunes the fused-span length K (``parallel.make_training_span``):
+    how many generations one donated device program scans before the
+    host fetches results. Each K candidate is its OWN compiled program
+    (lax.scan length is a static shape), so the span knob is menu-only —
+    an off-grid midpoint would buy nothing but another compile. Every
+    candidate keeps a persistent (state, stats) pair rebound after each
+    call — the programs donate their search state, exactly like the
+    consumers — and the budget contract keeps the per-generation work
+    identical across trials, so steps/sec is the only moving part. The
+    baseline is the SAME generation body dispatched from the host loop
+    (``make_generation_step``, same mesh), making
+    ``speedup_vs_baseline`` the span_speedup of docs/sharding.md."""
+
+    group = "span"
+    program = "gspmd.training_span"
+    #: the budget contract keeps every lane active; throughput selection
+    default_min_occupancy: Optional[float] = None
+
+    def __init__(
+        self,
+        shape: TuneShape,
+        *,
+        spans: Sequence[int] = (1, 2, 4, 8, 16),
+        seed: int = 0,
+    ):
+        super().__init__(shape, seed=seed)
+        self.spans = tuple(sorted({int(s) for s in spans if int(s) >= 1}))
+        if not self.spans:
+            raise ValueError("empty span menu; pass --spans with ints >= 1")
+        from ..parallel import default_mesh
+
+        self._mesh = default_mesh(("pop",))
+        self._programs: Dict[int, Any] = {}
+        self._span_state: Dict[int, Any] = {}
+        self._baseline_step = None
+        self._baseline_state = None
+        self._seed = int(seed)
+
+    # -- program/state builders --------------------------------------------
+    def _ask_tell(self):
+        from functools import partial
+
+        from ..algorithms.functional import pgpe_ask, pgpe_tell
+
+        return partial(pgpe_ask, popsize=self.shape.popsize), pgpe_tell
+
+    def _fresh_state(self):
+        import jax.numpy as jnp
+
+        from ..algorithms.functional import pgpe
+        from ..neuroevolution.net.runningnorm import RunningNorm
+
+        state = pgpe(
+            center_init=jnp.zeros(
+                self.policy.parameter_count, dtype=jnp.float32
+            ),
+            center_learning_rate=0.1,
+            stdev_learning_rate=0.1,
+            objective_sense="max",
+            stdev_init=0.1,
+        )
+        return state, RunningNorm(self.env.observation_size).stats
+
+    def _rollout_kwargs(self):
+        return dict(
+            eval_mode="budget",
+            num_episodes=self.shape.num_episodes,
+            episode_length=self.shape.episode_length,
+            compute_dtype=self.shape.compute_dtype,
+        )
+
+    def _program_for(self, span: int):
+        span = int(span)
+        if span not in self._programs:
+            from ..parallel import make_training_span
+
+            ask, tell = self._ask_tell()
+            self._programs[span] = make_training_span(
+                self.env,
+                self.policy,
+                ask=ask,
+                tell=tell,
+                popsize=self.shape.popsize,
+                span=span,
+                mesh=self._mesh,
+                **self._rollout_kwargs(),
+            )
+            self._span_state[span] = self._fresh_state()
+        return self._programs[span]
+
+    def default_config(self):
+        return {"span": self.spans[0]}
+
+    def knob_group(self) -> KnobGroup:
+        return KnobGroup(
+            name=self.group,
+            # menu-only: each span length is a distinct compiled program
+            knobs=(KnobSpec("span", self.spans, refine=False),),
+        )
+
+    def run_once(self, config, key, *, warmup: bool = False):
+        import types
+
+        import jax
+
+        span = int(config["span"])
+        fn = self._program_for(span)
+
+        def call(k):
+            state, stats = self._span_state[span]
+            new_state, scores, new_stats, steps, _ = fn(
+                state, jax.random.split(k, span), stats
+            )
+            self._span_state[span] = (new_state, new_stats)
+            return scores, steps
+
+        scores, steps = call(key)
+        if warmup:
+            # donated GSPMD programs reach the steady-state layout on the
+            # SECOND call — run one more untimed so no compile can land
+            # inside a timed trial (the bench A/B warms the same way)
+            jax.block_until_ready(scores)
+            scores, steps = call(self._next_key())
+            jax.block_until_ready(scores)
+        return types.SimpleNamespace(
+            scores=scores, total_steps=steps.sum(), telemetry=None
+        )
+
+    def cost(self, config):
+        """Analytic cost of the candidate's fused-span program (one AOT
+        capture, outside every timed region) — the ISSUE's compile-time
+        cost surface for long spans, plus the peak-HBM prune input."""
+        import jax
+
+        from .programs import ProgramLedger, abstract_like
+
+        span = int(config["span"])
+        from ..parallel import make_training_span
+
+        ask, tell = self._ask_tell()
+        fn = make_training_span(
+            self.env,
+            self.policy,
+            ask=ask,
+            tell=tell,
+            popsize=self.shape.popsize,
+            span=span,
+            mesh=self._mesh,
+            donate_state=False,  # AOT analysis only; nothing is consumed
+            **self._rollout_kwargs(),
+        )
+        state, stats = self._fresh_state()
+        led = ProgramLedger()
+        record = led.capture(
+            self.program,
+            fn,
+            abstract_like(state),
+            jax.random.split(jax.random.key(0), span),
+            abstract_like(stats),
+            shape=dict(self.shape.as_dict(), span=span),
+        )
+        return {
+            "peak_bytes": record.peak_bytes,
+            "flops": record.flops,
+            "compile_seconds": record.compile_seconds,
+        }
+
+    def baseline(self, trials: int = 3) -> Dict[str, Any]:
+        """Median steps/s of the host loop: the SAME generation body
+        (``make_generation_step``, same mesh, same contract) dispatched
+        ``max(spans)`` times per sample from the host — the denominator
+        that makes ``speedup_vs_baseline`` the span A/B headline."""
+        if self._episodes_baseline is not None:
+            return self._episodes_baseline
+        import jax
+
+        from ..parallel import make_generation_step
+
+        if self._baseline_step is None:
+            ask, tell = self._ask_tell()
+            self._baseline_step = make_generation_step(
+                self.env,
+                self.policy,
+                ask=ask,
+                tell=tell,
+                popsize=self.shape.popsize,
+                mesh=self._mesh,
+                **self._rollout_kwargs(),
+            )
+            self._baseline_state = self._fresh_state()
+        gens = max(self.spans)
+
+        def runner(key):
+            import types
+
+            state, stats = self._baseline_state
+            steps_total = 0
+            scores = None
+            for g in range(gens):
+                state, scores, stats, steps, _ = self._baseline_step(
+                    state, jax.random.fold_in(key, g), stats
+                )
+                steps_total += int(steps)
+            self._baseline_state = (state, stats)
+            return types.SimpleNamespace(
+                scores=scores, total_steps=steps_total, telemetry=None
+            )
+
+        # two untimed warmups: fresh layout, then steady-state donated layout
+        jax.block_until_ready(runner(self._next_key()).scores)
+        jax.block_until_ready(runner(self._next_key()).scores)
+        samples = []
+        for _ in range(max(1, trials)):
+            sps, _, _ = self._timed_call(
+                "span_hostloop", {"contract": "hostloop"}, runner
+            )
+            samples.append(sps)
+        self._episodes_baseline = {
+            "steps_per_sec": _median(samples),
+            "occupancy": None,
+            "samples": samples,
+        }
+        return self._episodes_baseline
+
+    def tuned_config(self, config):
+        return {"span": int(config["span"])}
+
+
 class HostPipelineHarness:
     """Tunes the HOST-path knobs: the pipelined scheduler's lane-block
     count and (for MuJoCo backends) the physics thread-pool width. These
@@ -1534,7 +1764,7 @@ def main(argv=None) -> int:
         "--group",
         default="refill",
         help="comma list of knob groups: refill, compact, host_pipeline, "
-        "policy",
+        "policy, span",
     )
     parser.add_argument("--cpu", action="store_true",
                         help="force the 8-virtual-device CPU backend")
@@ -1565,6 +1795,9 @@ def main(argv=None) -> int:
     parser.add_argument("--trunk-blocks", default="0",
                         help="policy-group lane-block grid (comma list; 0 = "
                         "unblocked, others must divide the popsize)")
+    parser.add_argument("--spans", default="1,2,4,8,16",
+                        help="span-group fused-span length grid (comma list; "
+                        "each K is its own compiled program)")
     parser.add_argument("--hbm-budget", type=float, default=None,
                         help="absolute peak-HBM prune budget in bytes")
     parser.add_argument("--hbm-budget-ratio", type=float, default=8.0,
@@ -1586,7 +1819,9 @@ def main(argv=None) -> int:
 
     use_cpu = _setup_backend(args.cpu)
     groups = [g.strip() for g in args.group.split(",") if g.strip()]
-    unknown = set(groups) - {"refill", "compact", "host_pipeline", "policy"}
+    unknown = set(groups) - {
+        "refill", "compact", "host_pipeline", "policy", "span"
+    }
     if unknown:
         parser.error(f"unknown group(s): {sorted(unknown)}")
 
@@ -1617,6 +1852,12 @@ def main(argv=None) -> int:
                 shape,
                 ranks=[int(r) for r in args.ranks.split(",") if r],
                 trunk_blocks=[int(b) for b in args.trunk_blocks.split(",") if b != ""],
+                seed=args.seed,
+            )
+        elif group_name == "span":
+            harness = SpanHarness(
+                shape,
+                spans=[int(s) for s in args.spans.split(",") if s],
                 seed=args.seed,
             )
         else:

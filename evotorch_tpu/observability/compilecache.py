@@ -59,7 +59,9 @@ def _install_listener() -> None:
     _LISTENER_INSTALLED = True
 
 
-def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
+def enable_persistent_cache(
+    cache_dir: Optional[str] = None, *, xla_caches: bool = True
+) -> str:
     """Enable jax's persistent compilation cache rooted at ``cache_dir``.
 
     Thresholds are dropped to zero so even fast-compiling programs are
@@ -80,11 +82,16 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    try:
-        # Also cache XLA-internal autotuning artifacts where supported.
-        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
-    except Exception:  # graftlint: allow(swallow): older jax without the XLA-caches option; the main cache is already on
-        pass
+    if xla_caches:
+        # ``xla_caches=False`` opts out (the test suite does): "all" embeds
+        # extra machine-local cache paths into the hashed compile options, so
+        # entries re-key whenever the directory moves, and the XLA-internal
+        # autotuning caches buy nothing on the CPU backend anyway.
+        try:
+            # Also cache XLA-internal autotuning artifacts where supported.
+            jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+        except Exception:  # graftlint: allow(swallow): older jax without the XLA-caches option; the main cache is already on
+            pass
     _install_listener()
     _ENABLED_DIR = path
     return path

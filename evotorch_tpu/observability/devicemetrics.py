@@ -92,6 +92,7 @@ __all__ = [
     "pack_group_telemetry",
     "compute_health_block",
     "append_health_block",
+    "device_episode_total",
     "queue_wait_bucket_index",
     "EvalTelemetry",
     "GroupTelemetry",
@@ -259,6 +260,25 @@ def _split_health(values: np.ndarray):
         values[:, GROUP_TELEMETRY_WIDTH:], dtype=np.int32
     )
     return counter, health_bits.view(np.float32).astype(np.float64)
+
+
+def device_episode_total(telemetry):
+    """Sum the ``episodes`` slot of a telemetry wire ON DEVICE (jit-safe —
+    no host fetch, so async counter bumps stay async): accepts a v1
+    ``(TELEMETRY_WIDTH,)`` vector, a ``(G, C)`` matrix, or a STACKED
+    ``(K, G, C)`` span of matrices; returns an int32 scalar (0 for an
+    empty/telemetry-off wire). The single sanctioned device-side column
+    read of the wire — span consumers use it to bump episode counters
+    without decoding the stacked rows eagerly."""
+    import jax.numpy as jnp
+
+    t = jnp.asarray(telemetry)
+    if t.size == 0:
+        return jnp.zeros((), dtype=jnp.int32)
+    col = _SLOTS.index("episodes")
+    if t.ndim == 1:
+        return t[col].astype(jnp.int32)
+    return t[..., col].sum().astype(jnp.int32)
 
 
 def queue_wait_bucket_index(waits):

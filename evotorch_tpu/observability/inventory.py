@@ -65,6 +65,7 @@ class GateConfig:
     batched_dim: int = 8
     batched_popsize: int = 8
     batched_generations: int = 3
+    span: int = 3
 
 
 @dataclass(frozen=True)
@@ -130,21 +131,21 @@ def _gaussian_programs():
 def _batched_search_program(num_searches: int, dim: int, popsize: int):
     """The examples/functional_batched_search.py program shape: N
     independent CEM searches scanned as ONE jitted, state-donating
-    program (batch dims on the state)."""
-    import jax
+    program (batch dims on the state) — built on the shared
+    scanned-generations idiom (``algorithms.functional.make_search_span``),
+    the same helper the example itself uses."""
+    import functools as ft
+
     import jax.numpy as jnp
 
-    from ..algorithms.functional import cem_ask, cem_tell
+    from ..algorithms.functional import cem_ask, cem_tell, make_search_span
 
-    def _generation(state, key):
-        pop = cem_ask(key, state, popsize=popsize)
-        fit = jnp.sum(pop**2, axis=-1)
-        return cem_tell(state, pop, fit), jnp.min(fit, axis=-1)
-
-    def _run(state, keys):
-        return jax.lax.scan(_generation, state, keys)
-
-    return jax.jit(_run, donate_argnums=(0,))
+    return make_search_span(
+        lambda pop: jnp.sum(pop**2, axis=-1),
+        ask=ft.partial(cem_ask, popsize=popsize),
+        tell=cem_tell,
+        metrics=lambda pop, fit: jnp.min(fit, axis=-1),
+    )
 
 
 @functools.lru_cache(maxsize=8)
@@ -301,6 +302,33 @@ def _gspmd_generation_program(env, policy, mesh_size, popsize, episode_length):
         ask=lambda k, s: pgpe_ask(k, s, popsize=popsize),
         tell=pgpe_tell,
         popsize=popsize,
+        mesh=mesh,
+        num_episodes=1,
+        episode_length=episode_length,
+        eval_mode="budget",
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _gspmd_span_program(env, policy, mesh_size, popsize, episode_length, span):
+    """parallel.make_training_span at the gate shape: ``span`` generations
+    of the GSPMD ask -> rollout -> tell body scanned into ONE donated
+    program (docs/sharding.md "Fused multi-generation training spans")."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..algorithms.functional import pgpe_ask, pgpe_tell
+    from ..parallel.evaluate import make_training_span
+
+    mesh = Mesh(np.asarray(jax.devices()[:mesh_size]), axis_names=("pop",))
+    return make_training_span(
+        env,
+        policy,
+        ask=lambda k, s: pgpe_ask(k, s, popsize=popsize),
+        tell=pgpe_tell,
+        popsize=popsize,
+        span=span,
         mesh=mesh,
         num_episodes=1,
         episode_length=episode_length,
@@ -619,6 +647,23 @@ def build_specs(cfg: Optional[GateConfig] = None) -> List[ProgramSpec]:
         )
 
     add("gspmd.generation", sharded_shape, gspmd_capture)
+
+    span_shape = dict(sharded_shape, span=cfg.span)
+
+    def span_capture(led):
+        fn = _gspmd_span_program(
+            env, policy, mesh_size, cfg.popsize, cfg.episode_length, cfg.span
+        )
+        return led.capture(
+            "gspmd.training_span",
+            fn,
+            _abstract(_fresh_pgpe_state(L)),
+            jax.random.split(jax.random.key(0), cfg.span),
+            stats,
+            shape=span_shape,
+        )
+
+    add("gspmd.training_span", span_shape, span_capture)
     return specs
 
 
@@ -678,7 +723,8 @@ def capture_inventory(
 def donated_programs(cfg: Optional[GateConfig] = None):
     """``(name, fn, args, donate_argnums)`` for every ``donate_argnums``
     entry point the repo registers — bench tell, the bench and multichip
-    generation steps, and the batched functional search. Each call builds
+    generation steps, the GSPMD training span, and the batched functional
+    search. Each call builds
     FRESH concrete arguments (the verification executes the program and
     consumes the donated buffers). The dynamic complement of graftlint's
     static ``donation`` checker: these assert XLA *applied* the aliasing."""
@@ -731,6 +777,18 @@ def donated_programs(cfg: Optional[GateConfig] = None):
                 env, policy, mesh_size, cfg.popsize, cfg.episode_length
             ),
             (_fresh_pgpe_state(L), jax.random.key(0), stats),
+            (0,),
+        ),
+        (
+            "gspmd.training_span",
+            _gspmd_span_program(
+                env, policy, mesh_size, cfg.popsize, cfg.episode_length, cfg.span
+            ),
+            (
+                _fresh_pgpe_state(L),
+                jax.random.split(jax.random.key(0), cfg.span),
+                stats,
+            ),
             (0,),
         ),
         (

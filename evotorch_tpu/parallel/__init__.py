@@ -38,6 +38,7 @@ from .evaluate import (
     make_generation_step,
     make_sharded_evaluator,
     make_sharded_rollout_evaluator,
+    make_training_span,
     population_spec,
     shard_population,
 )
@@ -55,6 +56,7 @@ __all__ = [
     "make_generation_step",
     "make_sharded_evaluator",
     "make_sharded_rollout_evaluator",
+    "make_training_span",
     "population_spec",
     "shard_population",
     "make_sharded_grad_estimator",

@@ -40,6 +40,7 @@ __all__ = [
     "make_generation_step",
     "make_sharded_evaluator",
     "make_sharded_rollout_evaluator",
+    "make_training_span",
     "population_spec",
     "shard_population",
 ]
@@ -659,46 +660,28 @@ def _shard_map_rollout_evaluator(
     return evaluator
 
 
-def make_generation_step(
+def _generation_body(
     env,
     policy,
     *,
     ask: Callable,
     tell: Callable,
     popsize: int,
-    mesh: Optional[Mesh] = None,
-    donate_state: bool = True,
+    mesh: Mesh,
     **rollout_kwargs,
 ):
-    """One whole generation — ``ask -> sharded rollout -> tell`` — compiled
-    as ONE jitted GSPMD program with the evolution state DONATED: the
-    sample buffers, the rollout working set and the updated distribution
-    state all reuse the previous generation's HBM, so a training loop's
-    steady-state footprint is a single generation's live set (the program
-    ledger's donation verification covers this program;
-    ``docs/observability.md``).
-
-    ``ask(key, state) -> values`` samples the ``(popsize, L)`` population
-    (dense, ``LowRankParamsBatch``, or ``TrunkDeltaParamsBatch`` — e.g.
-    ``pgpe_ask_trunk_delta``); ``tell(state, values, scores) -> state``
-    applies the update. Both run INSIDE the program — the population is born
-    on its shards, evaluated in place, and consumed by the update without
-    ever leaving the device grid.
-
-    Returns ``generation(state, key, stats) -> (state, scores, stats,
-    total_steps, telemetry)``. With ``donate_state=True`` (default) the
-    caller must rebind: ``state, ... = generation(state, key, stats)`` —
-    the old state's buffers are invalidated.
-    """
+    """The UNJITTED ``ask -> sharded rollout -> tell`` generation body shared
+    by :func:`make_generation_step` (which jits it as-is) and
+    :func:`make_training_span` (which ``lax.scan``s it K times inside one
+    program). Keeping one body is what makes the span bit-identity guarantee
+    structural: the scanned step IS the per-generation step, traced from the
+    same closure."""
     from ..neuroevolution.net.vecrl import run_vectorized_rollout
     from ..observability.devicemetrics import (
         append_health_block,
         compute_health_block,
     )
 
-    _check_reserved(rollout_kwargs, "make_generation_step")
-    if mesh is None:
-        mesh = default_mesh(("pop",))
     popsize = int(popsize)
     n_grid = _mesh_grid_size(mesh)
     padded_n = -(-popsize // n_grid) * n_grid
@@ -758,4 +741,139 @@ def make_generation_step(
                 )
         return new_state, scores, result.stats, result.total_steps, telemetry
 
+    return generation
+
+
+def make_generation_step(
+    env,
+    policy,
+    *,
+    ask: Callable,
+    tell: Callable,
+    popsize: int,
+    mesh: Optional[Mesh] = None,
+    donate_state: bool = True,
+    **rollout_kwargs,
+):
+    """One whole generation — ``ask -> sharded rollout -> tell`` — compiled
+    as ONE jitted GSPMD program with the evolution state DONATED: the
+    sample buffers, the rollout working set and the updated distribution
+    state all reuse the previous generation's HBM, so a training loop's
+    steady-state footprint is a single generation's live set (the program
+    ledger's donation verification covers this program;
+    ``docs/observability.md``).
+
+    ``ask(key, state) -> values`` samples the ``(popsize, L)`` population
+    (dense, ``LowRankParamsBatch``, or ``TrunkDeltaParamsBatch`` — e.g.
+    ``pgpe_ask_trunk_delta``); ``tell(state, values, scores) -> state``
+    applies the update. Both run INSIDE the program — the population is born
+    on its shards, evaluated in place, and consumed by the update without
+    ever leaving the device grid.
+
+    Returns ``generation(state, key, stats) -> (state, scores, stats,
+    total_steps, telemetry)``. With ``donate_state=True`` (default) the
+    caller must rebind: ``state, ... = generation(state, key, stats)`` —
+    the old state's buffers are invalidated.
+    """
+    _check_reserved(rollout_kwargs, "make_generation_step")
+    if mesh is None:
+        mesh = default_mesh(("pop",))
+    generation = _generation_body(
+        env, policy, ask=ask, tell=tell, popsize=popsize, mesh=mesh,
+        **rollout_kwargs,
+    )
     return jax.jit(generation, donate_argnums=(0,) if donate_state else ())
+
+
+def make_training_span(
+    env,
+    policy,
+    *,
+    ask: Callable,
+    tell: Callable,
+    popsize: int,
+    span: int,
+    mesh: Optional[Mesh] = None,
+    donate_state: bool = True,
+    state_metrics: Optional[Callable] = None,
+    **rollout_kwargs,
+):
+    """``span`` generations fused into ONE jitted, state-donating GSPMD
+    program: a ``lax.scan`` over the :func:`make_generation_step` body, so a
+    training loop pays Python dispatch + device sync + telemetry decode once
+    per K generations instead of once per generation (the Podracer/Anakin
+    move applied to the ES outer loop; ``docs/sharding.md`` "Fused
+    multi-generation training spans").
+
+    ``ask``/``tell``/``popsize``/``mesh``/``rollout_kwargs`` mean exactly
+    what they mean for :func:`make_generation_step` — the scanned step is the
+    SAME traced body, so the result is bit-identical (state pytree, scores,
+    telemetry column sums, obs-norm stats) to ``span`` sequential
+    ``make_generation_step`` calls fed the same per-generation keys, at any
+    mesh shape including padded indivisible popsizes. The obs-norm ``stats``
+    ride the scan carry, preserving the sequential update order.
+
+    ``eval_mode="episodes_compact"`` is rejected: lane compaction is
+    host-orchestrated (chunked re-dispatch from Python;
+    ``docs/eval_contracts.md``), so it cannot live inside a monolithic
+    scanned program — use ``episodes_refill`` for the on-device
+    work-conserving form.
+
+    ``state_metrics(state) -> pytree`` (optional, e.g.
+    ``algorithms.functional.pgpe_health``) is evaluated on the post-``tell``
+    state of EVERY generation inside the program; its stacked outputs let
+    hosts reconstruct per-generation algorithm-health rows without K extra
+    dispatches.
+
+    Returns ``training_span(state, keys, stats) -> (state, scores, stats,
+    total_steps, telemetry[, metrics])`` where ``keys`` is a ``(span,)``
+    PRNG key array (one per generation — e.g. ``jax.random.split(key,
+    span)``; scan raises at trace time on a length mismatch) and the ys are
+    stacked per generation: ``scores (span, popsize)``, ``total_steps
+    (span,)``, ``telemetry (span, G, C)`` (or ``(span, 0)`` with telemetry
+    off — decode row-by-row, see docs/observability.md "Lag-by-span"), and
+    ``metrics`` the stacked ``state_metrics`` pytree when provided. With
+    ``donate_state=True`` (default) the caller must rebind ``state``.
+    """
+    _check_reserved(rollout_kwargs, "make_training_span")
+    span = int(span)
+    if span < 1:
+        raise ValueError(f"span must be >= 1, got {span}")
+    if rollout_kwargs.get("eval_mode") == "episodes_compact":
+        raise ValueError(
+            "make_training_span cannot fuse eval_mode='episodes_compact': "
+            "lane compaction is host-orchestrated (chunked re-dispatch from "
+            "Python) and cannot run inside one scanned device program — use "
+            "'episodes_refill' for the on-device work-conserving contract"
+        )
+    if mesh is None:
+        mesh = default_mesh(("pop",))
+    generation = _generation_body(
+        env, policy, ask=ask, tell=tell, popsize=popsize, mesh=mesh,
+        **rollout_kwargs,
+    )
+
+    def training_span(state, keys, stats):
+        kshape = jnp.shape(keys)
+        if not kshape or kshape[0] != span:
+            raise ValueError(
+                f"training_span expects a (span={span},) PRNG key array — "
+                f"one key per generation, e.g. jax.random.split(key, {span}) "
+                f"— got key shape {kshape}"
+            )
+
+        def body(carry, key):
+            state, stats = carry
+            state, scores, stats, steps, telemetry = generation(state, key, stats)
+            ys = (scores, steps, telemetry)
+            if state_metrics is not None:
+                ys = ys + (state_metrics(state),)
+            return (state, stats), ys
+
+        (state, stats), ys = jax.lax.scan(body, (state, stats), keys, length=span)
+        out = (state, ys[0], stats, ys[1], ys[2])
+        if state_metrics is not None:
+            out = out + (ys[3],)
+        return out
+
+    return jax.jit(training_span, donate_argnums=(0,) if donate_state else ())

@@ -2,7 +2,9 @@
 
 A *population of searches*: 8 independent CEM searches with different
 hyperparameters run as one jitted program (extra leftmost dims on the state =
-batch dims).
+batch dims). The scanned multi-generation program comes from
+``make_search_span`` — the repo's one scanned-generations idiom, shared with
+the program ledger's ``functional_batched_search`` gate capture.
 """
 
 from _common import setup_platform
@@ -14,7 +16,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from evotorch_tpu.algorithms.functional import cem, cem_ask, cem_tell
+from evotorch_tpu.algorithms.functional import cem, cem_ask, cem_tell, make_search_span
 
 
 def sphere(x):
@@ -33,16 +35,14 @@ def main():
         stdev_max_change=0.2,
     )
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def run(state, key):
-        def gen(state, key):
-            pop = cem_ask(key, state, popsize=50)
-            fit = sphere(pop)
-            return cem_tell(state, pop, fit), jnp.min(fit, axis=-1)
-
-        return jax.lax.scan(gen, state, jax.random.split(key, args.generations or 100))
-
-    state, best_per_gen = run(state, jax.random.key(1))
+    run = make_search_span(
+        sphere,
+        ask=partial(cem_ask, popsize=50),
+        tell=cem_tell,
+        metrics=lambda pop, fit: jnp.min(fit, axis=-1),
+    )
+    keys = jax.random.split(jax.random.key(1), args.generations or 100)
+    state, best_per_gen = run(state, keys)
     print("final best per search:", jnp.round(best_per_gen[-1], 4))
 
 

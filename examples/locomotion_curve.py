@@ -56,6 +56,18 @@ def parse_args():
                    help="policy DSL; default: 2x64-tanh MLP")
     p.add_argument("--out", default=None)
     p.add_argument("--seed", type=int, default=0)
+    # fused training spans (docs/sharding.md "Fused multi-generation
+    # training spans"): K generations of the SAME ClipUp recipe — run
+    # through the functional PGPE state — scanned into ONE donated device
+    # program per block (VecNE.make_training_span); the per-generation JSONL
+    # rows are reconstructed host-side from the program's stacked outputs,
+    # so the curve schema matches the host-loop path. Per-generation PRNG
+    # keys derive from the ABSOLUTE generation index, so checkpoint resume
+    # replays the exact uninterrupted trajectory.
+    p.add_argument("--span", type=int, default=None,
+                   help="fuse K generations per device dispatch; "
+                        "--checkpoint-every rounds UP to the next span "
+                        "boundary (the program only yields between blocks)")
     # durable checkpoint/resume (resilience.RunCheckpointer,
     # docs/resilience.md): with --checkpoint-dir the run saves a bundle
     # every --checkpoint-every generations and AUTO-RESUMES from the newest
@@ -67,6 +79,16 @@ def parse_args():
     p.add_argument("--no-resume", action="store_true",
                    help="ignore existing bundles; start fresh (still saves)")
     return p.parse_args()
+
+
+def span_checkpoint_every(every: int, span: int) -> int:
+    """``--checkpoint-every`` aligned to fused-span boundaries: the scanned
+    program only hands control back between K-generation blocks, so the
+    cadence rounds UP to the next multiple of ``span`` (never down — down
+    would checkpoint MORE often than asked). With the cadence a span
+    multiple, ``maybe_save`` fires exactly at block ends and resume restarts
+    on a block boundary — the resumed trajectory stays bit-identical."""
+    return -(-int(every) // int(span)) * int(span)
 
 
 def main():
@@ -86,11 +108,21 @@ def main():
         probe_devices()
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from evotorch_tpu.algorithms import PGPE
     from evotorch_tpu.envs import make_env
     from evotorch_tpu.neuroevolution import VecNE
     from evotorch_tpu.neuroevolution.net.vecrl import run_vectorized_rollout
+
+    if args.span and (
+        args.num_interactions or args.popsize_max or args.lowrank_rank
+    ):
+        raise SystemExit(
+            "--span fuses a fixed-shape program; the adaptive "
+            "--num-interactions/--popsize-max knobs and --lowrank-rank need "
+            "the per-generation host loop"
+        )
 
     out_path = args.out or f"{args.env}_curve.jsonl"
     compute_dtype = jnp.bfloat16 if args.bf16 else None
@@ -115,19 +147,21 @@ def main():
         decrease_rewards_by=decrease,
         seed=args.seed,
     )
-    searcher = PGPE(
-        problem,
-        popsize=args.popsize,
-        center_learning_rate=center_lr,
-        stdev_learning_rate=args.stdev_lr,
-        radius_init=radius_init,
-        optimizer="clipup",
-        optimizer_config={"max_speed": args.max_speed},
-        ranking_method="centered",
-        num_interactions=args.num_interactions,
-        popsize_max=args.popsize_max,
-        lowrank_rank=args.lowrank_rank,
-    )
+    searcher = None
+    if not args.span:
+        searcher = PGPE(
+            problem,
+            popsize=args.popsize,
+            center_learning_rate=center_lr,
+            stdev_learning_rate=args.stdev_lr,
+            radius_init=radius_init,
+            optimizer="clipup",
+            optimizer_config={"max_speed": args.max_speed},
+            ranking_method="centered",
+            num_interactions=args.num_interactions,
+            popsize_max=args.popsize_max,
+            lowrank_rank=args.lowrank_rank,
+        )
 
     # search-health watchdog (docs/observability.md "Search health"):
     # variance-gated plateau detection on the on-device score statistics
@@ -145,20 +179,31 @@ def main():
     # to the same JSONL — bit-identical to the run that was never killed
     ckpt = None
     start_gen = 1
+    span_resume = None
     if args.checkpoint_dir:
         from evotorch_tpu.resilience import RunCheckpointer
 
+        every = args.checkpoint_every
+        if args.span:
+            # the fused program only yields between K-generation blocks:
+            # round the cadence UP to the next span boundary (documented on
+            # the --span flag) so maybe_save fires exactly at block ends
+            every = span_checkpoint_every(every, args.span)
         ckpt = RunCheckpointer(
             args.checkpoint_dir,
             keep=args.checkpoint_keep,
-            every=args.checkpoint_every,
+            every=every,
         )
         if not args.no_resume:
             loaded = ckpt.load_latest()
             if loaded is not None:
                 gen_done, state = loaded
-                searcher = state["searcher"]
-                problem = searcher.problem
+                if args.span:
+                    # functional-state bundle; rehydrated in the span loop
+                    span_resume = state
+                else:
+                    searcher = state["searcher"]
+                    problem = searcher.problem
                 start_gen = gen_done + 1
                 # the bundle carries the health-detector window state, so
                 # the resumed run's verdict timing is bit-identical to the
@@ -182,8 +227,11 @@ def main():
     except TypeError:
         nobonus_env = None
 
-    def eval_center():
-        center = jnp.asarray(searcher.status["center"])[None]
+    def eval_center(center, step_count):
+        # numpy, not jnp: the replicated center goes straight into the
+        # jitted rollout dispatch, and a numpy argument is ~3x cheaper per
+        # dispatch than a committed device array (CLAUDE.md r7 note)
+        batch = np.repeat(np.asarray(center)[None], args.eval_episodes, axis=0)
         stats = problem.obs_norm.stats
         outs = {}
         for name, env in (("full", eval_env), ("no_alive_bonus", nobonus_env)):
@@ -192,8 +240,8 @@ def main():
             r = run_vectorized_rollout(
                 env,
                 problem._policy,
-                jnp.repeat(center, args.eval_episodes, axis=0),
-                jax.random.fold_in(jax.random.key(args.seed + 1), searcher.step_count),
+                batch,
+                jax.random.fold_in(jax.random.key(args.seed + 1), step_count),
                 stats,
                 num_episodes=1,
                 episode_length=args.episode_length,
@@ -223,6 +271,189 @@ def main():
     )
 
     t_start = time.time()
+    if args.span:
+        # --span K: blocks of K generations fused into one donated device
+        # program; the host fetches the stacked (scores, telemetry, health,
+        # center) outputs ONCE per block and reconstructs the per-generation
+        # rows from them. Telemetry decodes per ROW from the same fetched
+        # wire, so occupancy stays per-generation accurate; the block's
+        # compile-count delta lands on its first row (nonzero on a warm
+        # block is a retrace, exactly like the host-loop column).
+        from evotorch_tpu.algorithms.functional import (
+            get_functional_optimizer,
+            pgpe,
+            pgpe_ask,
+            pgpe_health,
+            pgpe_tell,
+        )
+        from evotorch_tpu.observability import GroupTelemetry
+        from evotorch_tpu.observability.registry import counters
+
+        span = int(args.span)
+        state = pgpe(
+            center_init=jnp.zeros(
+                problem._policy.parameter_count, dtype=jnp.float32
+            ),
+            center_learning_rate=center_lr,
+            stdev_learning_rate=args.stdev_lr,
+            objective_sense="max",
+            radius_init=radius_init,
+            optimizer="clipup",
+            optimizer_config={"max_speed": args.max_speed},
+            ranking_method="centered",
+        )
+        best_eval = None
+        if span_resume is not None:
+            state = jax.tree_util.tree_map(jnp.asarray, span_resume["state"])
+            problem.obs_norm.stats = jax.tree_util.tree_map(
+                jnp.asarray, span_resume["obs_stats"]
+            )
+            problem._interaction_count = int(span_resume["interactions"])
+            problem._episode_count = int(span_resume["episodes"])
+            best_eval = span_resume.get("best_eval")
+
+        def metrics_fn(s):
+            # stdev/velocity norms AND the post-tell center of every
+            # generation ride the scan ys, so the periodic center
+            # evaluations need no extra device round trips
+            m = dict(pgpe_health(s))
+            m["center"] = get_functional_optimizer(s.optimizer)[1](
+                s.optimizer_state
+            )
+            return m
+
+        programs = {}
+
+        def span_program(length):
+            # one compile per distinct block length: every full block is
+            # `span`; only a trailing remainder block compiles a second form
+            if length not in programs:
+                programs[length] = problem.make_training_span(
+                    ask=lambda k, s: pgpe_ask(k, s, popsize=args.popsize),
+                    tell=pgpe_tell,
+                    popsize=args.popsize,
+                    span=length,
+                    state_metrics=metrics_fn,
+                )
+            return programs[length]
+
+        base_key = jax.random.key(args.seed)
+        centers_np = None
+        with open(out_path, "a") as f:
+            gen = start_gen
+            while gen <= args.generations:
+                length = min(span, args.generations - gen + 1)
+                fn = span_program(length)
+                # ABSOLUTE generation indices fold into the keys: a resumed
+                # run regenerates the identical per-generation randomness
+                keys = jax.vmap(lambda g: jax.random.fold_in(base_key, g))(
+                    jnp.arange(gen, gen + length)
+                )
+                meters = counters.snapshot(("compiles",))
+                result = fn(state, keys, problem.obs_norm.stats)
+                state, scores, _stats, _steps, telemetry, health = result
+                problem.consume_span(result[:5])
+                block_compiles = counters.delta(meters)["compiles"]
+                scores_np = np.asarray(scores)
+                health_np = {k: np.asarray(v) for k, v in health.items()}
+                centers_np = health_np.pop("center")
+                telemetry_np = (
+                    np.asarray(telemetry)
+                    if telemetry is not None and telemetry.size
+                    else None
+                )
+                for i in range(length):
+                    g = gen + i
+                    row_scores = scores_np[i]
+                    gen_best = float(row_scores.max())
+                    best_eval = (
+                        gen_best
+                        if best_eval is None
+                        else max(best_eval, gen_best)
+                    )
+                    gt = (
+                        GroupTelemetry.from_array(telemetry_np[i])
+                        if telemetry_np is not None
+                        else None
+                    )
+                    dec = gt.total() if gt is not None else None
+                    row = {
+                        "gen": g,
+                        "mean_eval": float(row_scores.mean()),
+                        "best_eval": best_eval,
+                        "stdev_norm": float(health_np["stdev_norm"][i]),
+                        "elapsed_s": round(time.time() - t_start, 1),
+                        "occupancy": (
+                            round(dec.occupancy, 4) if dec is not None else None
+                        ),
+                        "refill_events": (
+                            dec.refill_events if dec is not None else None
+                        ),
+                        "steady_compiles": block_compiles if i == 0 else 0,
+                    }
+                    if "velocity_norm" in health_np:
+                        row["clipup_velocity_norm"] = float(
+                            health_np["velocity_norm"][i]
+                        )
+                    if g % args.eval_every == 0 or g == args.generations:
+                        center_scores = eval_center(centers_np[i], g)
+                        row["center_full"] = center_scores.get("full")
+                        if "no_alive_bonus" in center_scores:
+                            row["center_no_alive_bonus"] = center_scores[
+                                "no_alive_bonus"
+                            ]
+                            row["center_bonus_term"] = (
+                                center_scores["full"]
+                                - center_scores["no_alive_bonus"]
+                            )
+                        print(json.dumps(row), flush=True)
+                    f.write(json.dumps(row) + "\n")
+                    f.flush()
+                    report = watchdog.check(
+                        gt, status={"stdev_norm": row["stdev_norm"]}
+                    )
+                    if hub is not None:
+                        hub.emit({**row, **report.as_status()}, telemetry=gt)
+                gen += length
+                if ckpt is not None:
+                    # save AFTER the block's rows are durably in the JSONL
+                    # (same discipline as the host loop); the functional
+                    # bundle carries everything a resume needs to replay
+                    # the uninterrupted trajectory bit-identically
+                    ckpt.maybe_save(
+                        gen - 1,
+                        {
+                            "state": jax.tree_util.tree_map(np.asarray, state),
+                            "obs_stats": jax.tree_util.tree_map(
+                                np.asarray, problem.obs_norm.stats
+                            ),
+                            "interactions": int(problem._interaction_count),
+                            "episodes": int(problem._episode_count),
+                            "best_eval": best_eval,
+                            "health": watchdog.state_dict(),
+                        },
+                    )
+        print(
+            json.dumps(
+                {
+                    "done": True,
+                    "env": args.env,
+                    "popsize": args.popsize,
+                    "generations": args.generations,
+                    "episode_length": args.episode_length,
+                    "interactions": int(
+                        problem.status["total_interaction_count"]
+                    ),
+                    "elapsed_s": round(time.time() - t_start, 1),
+                    "final_center": eval_center(
+                        centers_np[-1], args.generations
+                    ),
+                }
+            ),
+            flush=True,
+        )
+        return
+
     with open(out_path, "a") as f:
         for gen in range(start_gen, args.generations + 1):
             searcher.step()
@@ -255,7 +486,9 @@ def main():
                 # persistently << 1 at a stalling rank (the rank-32 curve)
                 row["basis_capture"] = searcher.status.get("basis_capture")
             if gen % args.eval_every == 0 or gen == args.generations:
-                center_scores = eval_center()
+                center_scores = eval_center(
+                    searcher.status["center"], searcher.step_count
+                )
                 row["center_full"] = center_scores.get("full")
                 if "no_alive_bonus" in center_scores:
                     # the velocity/bonus reward split: no_alive_bonus IS the
@@ -298,7 +531,9 @@ def main():
                 "episode_length": args.episode_length,
                 "interactions": int(problem.status["total_interaction_count"]),
                 "elapsed_s": round(time.time() - t_start, 1),
-                "final_center": eval_center(),
+                "final_center": eval_center(
+                    searcher.status["center"], searcher.step_count
+                ),
             }
         ),
         flush=True,

@@ -113,6 +113,21 @@ run autotune_policy 2400 env BENCH_HIDDEN=256,256 BENCH_BF16=1 \
   python -m evotorch_tpu.observability.autotune \
   --group policy --timings-out "$OUT/autotune_policy_timings.json"
 
+# 3d. fused-span autotune: sweep the span length K (each K is its own
+#     compiled program; the ledger's compile_seconds records what long
+#     spans cost) against the host-loop baseline on the real chip and
+#     persist the winner — the span_bench step below consults it via
+#     BENCH_SPAN=auto (docs/sharding.md "Fused multi-generation training
+#     spans")
+run autotune_span 2400 env BENCH_BF16=1 \
+  python -m evotorch_tpu.observability.autotune \
+  --group span --timings-out "$OUT/autotune_span_timings.json"
+
+# 3e. fused-span A/B at the flagship shape: K generations scanned into ONE
+#     donated GSPMD program vs the same body dispatched per generation from
+#     the host (span_speedup on the JSON line; steady_compiles must be 0)
+run span_bench 2400 env BENCH_BF16=1 BENCH_SPAN=auto python bench.py
+
 # 4. sharded bench on the single real chip (mesh of 1; exercise the path)
 run bench_multichip 1800 python bench_multichip.py
 

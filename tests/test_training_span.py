@@ -1,0 +1,360 @@
+"""Fused multi-generation training spans (docs/sharding.md): K generations
+scanned into ONE donated GSPMD program (``parallel.make_training_span``).
+
+The load-bearing claim is that the span is an EXECUTION DETAIL, exactly like
+the mesh: the scanned body is the same ``make_generation_step`` trace, so a
+span-K call is bit-identical — search state, scores, telemetry, obs-norm
+stats — to K sequential generation-step calls at any mesh shape, including
+padded indivisible popsizes. These tests pin that contract on the pytest
+8-virtual-device CPU mesh, plus the donation/retrace properties that make
+the fused program safe to put on the hot path.
+"""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from evotorch_tpu.algorithms.functional import (
+    make_search_span,
+    pgpe,
+    pgpe_ask,
+    pgpe_health,
+    pgpe_tell,
+)
+from evotorch_tpu.envs import CartPole
+from evotorch_tpu.neuroevolution.net import FlatParamsPolicy, Linear, Tanh
+from evotorch_tpu.neuroevolution.net.runningnorm import RunningNorm
+from evotorch_tpu.parallel import (
+    make_generation_step,
+    make_mesh,
+    make_training_span,
+)
+
+SPAN = 3
+# even (symmetric PGPE) but NOT divisible by the 8-device grid: every span
+# test also exercises the pad-and-mask path
+POPSIZE = 12
+
+# explicit refill knobs so the two legs cannot diverge through the
+# tuned-config cache (override provenance on both sides)
+_MODE_KWARGS = {
+    "budget": {},
+    "episodes": {},
+    "episodes_refill": {"refill_width": 4, "refill_period": 1},
+}
+
+
+@pytest.fixture(scope="module")
+def cartpole_setup():
+    env = CartPole()
+    policy = FlatParamsPolicy(
+        Linear(env.observation_size, 4) >> Tanh() >> Linear(4, env.action_size)
+    )
+    stats = RunningNorm(env.observation_size).stats
+    return env, policy, stats
+
+
+def _fresh_state(policy):
+    return pgpe(
+        center_init=jnp.zeros(policy.parameter_count),
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        objective_sense="max",
+        stdev_init=0.1,
+    )
+
+
+def _ask(popsize):
+    def ask(k, s):
+        return pgpe_ask(k, s, popsize=popsize)
+
+    return ask
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: span K == K sequential generation steps
+# ---------------------------------------------------------------------------
+
+
+def _run_both(env, policy, stats0, *, mesh_shape, eval_mode, popsize=POPSIZE):
+    kwargs = dict(
+        num_episodes=1, episode_length=4, eval_mode=eval_mode,
+        **_MODE_KWARGS[eval_mode],
+    )
+    mesh = make_mesh(mesh_shape)
+    gen = make_generation_step(
+        env, policy, ask=_ask(popsize), tell=pgpe_tell, popsize=popsize,
+        mesh=mesh, donate_state=False, **kwargs,
+    )
+    span_fn = make_training_span(
+        env, policy, ask=_ask(popsize), tell=pgpe_tell, popsize=popsize,
+        span=SPAN, mesh=mesh, donate_state=False,
+        state_metrics=pgpe_health, **kwargs,
+    )
+    keys = jax.random.split(jax.random.key(42), SPAN)
+
+    st, stats = _fresh_state(policy), stats0
+    seq_scores, seq_steps, seq_telem = [], [], []
+    for i in range(SPAN):
+        st, scores, stats, steps, telem = gen(st, keys[i], stats)
+        seq_scores.append(np.asarray(scores))
+        seq_steps.append(int(steps))
+        seq_telem.append(np.asarray(telem))
+    seq = (st, np.stack(seq_scores), stats, np.asarray(seq_steps),
+           np.stack(seq_telem))
+    fused = span_fn(_fresh_state(policy), keys, stats0)
+    return seq, fused
+
+
+# budget pins the contract in the fast tier; the episodes/refill variants
+# and the 2-D mesh recheck compile the same body again (~16s of pure
+# compile on this box), so they ride the slow tier with the other
+# sharded-topology sweeps
+@pytest.mark.parametrize(
+    "eval_mode",
+    [
+        "budget",
+        pytest.param("episodes", marks=pytest.mark.slow),
+        pytest.param("episodes_refill", marks=pytest.mark.slow),
+    ],
+)
+def test_span_bit_identity_padded_popsize(cartpole_setup, eval_mode):
+    env, policy, stats0 = cartpole_setup
+    seq, fused = _run_both(
+        env, policy, stats0, mesh_shape={"pop": 8}, eval_mode=eval_mode
+    )
+    st, scores, stats, steps, telem = seq
+    st2, scores2, stats2, steps2, telem2, metrics2 = fused
+    assert scores2.shape == (SPAN, POPSIZE)
+    np.testing.assert_array_equal(scores, np.asarray(scores2))
+    np.testing.assert_array_equal(steps, np.asarray(steps2))
+    np.testing.assert_array_equal(telem, np.asarray(telem2))
+    _assert_trees_equal(st, st2)  # the search state itself, every leaf
+    _assert_trees_equal(stats, stats2)  # obs-norm sufficient statistics
+    # state_metrics stacks one row per generation
+    assert np.asarray(metrics2["stdev_norm"]).shape == (SPAN,)
+
+
+@pytest.mark.slow
+def test_span_bit_identity_2d_mesh(cartpole_setup):
+    env, policy, stats0 = cartpole_setup
+    seq, fused = _run_both(
+        env, policy, stats0,
+        mesh_shape={"pop": 4, "model": 2}, eval_mode="budget",
+    )
+    st, scores, stats, steps, telem = seq
+    st2, scores2, stats2, steps2, telem2, _ = fused
+    np.testing.assert_array_equal(scores, np.asarray(scores2))
+    np.testing.assert_array_equal(steps, np.asarray(steps2))
+    np.testing.assert_array_equal(telem, np.asarray(telem2))
+    _assert_trees_equal(st, st2)
+    _assert_trees_equal(stats, stats2)
+
+
+# ---------------------------------------------------------------------------
+# contract validation
+# ---------------------------------------------------------------------------
+
+
+def test_span_rejects_compact_and_bad_span(cartpole_setup):
+    env, policy, _ = cartpole_setup
+    with pytest.raises(ValueError, match="episodes_compact"):
+        make_training_span(
+            env, policy, ask=_ask(8), tell=pgpe_tell, popsize=8, span=2,
+            eval_mode="episodes_compact",
+        )
+    with pytest.raises(ValueError, match="span"):
+        make_training_span(
+            env, policy, ask=_ask(8), tell=pgpe_tell, popsize=8, span=0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# donation + retrace discipline
+# ---------------------------------------------------------------------------
+
+
+def test_span_donates_and_stays_compile_free(cartpole_setup):
+    from evotorch_tpu.analysis import track_compiles
+    from evotorch_tpu.observability import ledger
+    from evotorch_tpu.observability.programs import abstract_like
+
+    env, policy, stats = cartpole_setup
+    span_fn = make_training_span(
+        env, policy, ask=_ask(8), tell=pgpe_tell, popsize=8, span=SPAN,
+        mesh=make_mesh({"pop": 8}),
+        num_episodes=1, episode_length=4, eval_mode="budget",
+    )
+
+    def call(state, seed):
+        return span_fn(state, jax.random.split(jax.random.key(seed), SPAN), stats)
+
+    donated = _fresh_state(policy)
+    state, scores, _, steps, _ = call(donated, 0)
+    assert scores.shape == (SPAN, 8)
+    assert np.asarray(steps).tolist() == [8 * 4] * SPAN
+    # runtime ground truth: jax deletes exactly the donated inputs whose
+    # aliasing the executable consumed
+    assert donated.stdev.is_deleted()
+
+    # with donation the second call commits the steady-state layout; after
+    # it, further spans must run with ZERO fresh compiles (the retrace
+    # sentinel — the property the whole fusion exists to buy)
+    state, *_ = call(state, 1)
+    with track_compiles() as compile_log:
+        for seed in (2, 3):
+            state, scores, _, _, _ = call(state, seed)
+        jax.block_until_ready(scores)
+    assert compile_log.count == 0
+
+    # the ledger's AOT donation verification agrees: every donated
+    # parameter is aliased in the compiled module
+    record = ledger.capture(
+        "test.training_span",
+        span_fn,
+        abstract_like(state),
+        jax.random.split(jax.random.key(9), SPAN),
+        abstract_like(stats),
+        shape={"popsize": 8, "span": SPAN, "mesh": "pop8"},
+    )
+    assert record.donation is not None
+    assert record.donation.missing == ()
+
+
+# ---------------------------------------------------------------------------
+# the functional-searcher span: one scanned-generations idiom
+# ---------------------------------------------------------------------------
+
+
+def test_make_search_span_matches_sequential():
+    from functools import partial
+
+    def fitness(pop):
+        return -jnp.sum(pop**2, axis=-1)
+
+    ask = partial(pgpe_ask, popsize=8)
+    state0 = pgpe(
+        center_init=jnp.zeros(5),
+        center_learning_rate=0.1,
+        stdev_learning_rate=0.1,
+        objective_sense="max",
+        stdev_init=0.1,
+    )
+    keys = jax.random.split(jax.random.key(5), 4)
+
+    # the hand-rolled scan the helper replaces (satellite: ONE
+    # scanned-generations idiom) — the SAME trace, so bit-identical
+    def generation(state, key):
+        pop = ask(key, state)
+        evals = fitness(pop)
+        return pgpe_tell(state, pop, evals), evals
+
+    st, seq_evals = jax.jit(
+        lambda s, k: jax.lax.scan(generation, s, k)
+    )(state0, keys)
+
+    span_fn = make_search_span(
+        fitness, ask=ask, tell=pgpe_tell, donate_state=False
+    )
+    st2, ys = span_fn(state0, keys)
+    np.testing.assert_array_equal(np.asarray(seq_evals), np.asarray(ys))
+    _assert_trees_equal(st, st2)
+
+    # eager per-generation calls agree numerically (XLA may reassociate
+    # float reductions differently across the per-call jit boundaries, so
+    # this anchor is allclose, not bit-equality)
+    st3 = state0
+    for i in range(4):
+        pop = ask(keys[i], st3)
+        st3 = pgpe_tell(st3, pop, fitness(pop))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(st2), jax.tree_util.tree_leaves(st3)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+
+# ---------------------------------------------------------------------------
+# VecNE wiring: stacked telemetry feeds the lag-by-span decode
+# ---------------------------------------------------------------------------
+
+
+def test_vecne_consume_span_counters_and_lag(cartpole_setup):
+    import evotorch_tpu  # noqa: F401  (shard_map alias)
+    from evotorch_tpu.neuroevolution import VecNE
+
+    prob = VecNE(
+        "cartpole",
+        "Linear(obs_length, 4) >> Tanh() >> Linear(4, act_length)",
+        eval_mode="episodes_refill",
+        refill_config={"width": 4, "period": 1},
+        observation_normalization=True,
+        num_episodes=1,
+        episode_length=4,
+    )
+    state = _fresh_state(prob._policy)
+    span_fn = prob.make_training_span(
+        ask=_ask(POPSIZE), tell=pgpe_tell, popsize=POPSIZE, span=SPAN,
+        donate_state=False,
+    )
+    result = span_fn(state, jax.random.split(jax.random.key(7), SPAN),
+                     prob.obs_norm.stats)
+    scores = prob.consume_span(result)
+    assert scores.shape == (SPAN, POPSIZE)
+    # every generation ran to episode end: exact counters, no estimate
+    assert int(prob.status["total_episode_count"]) == SPAN * POPSIZE
+    assert int(prob.status["total_interaction_count"]) == int(
+        np.asarray(result[3]).sum()
+    )
+    # lag-by-span: rows 0..K-2 decoded into status, the final row pending
+    assert prob._pending_telemetry is not None
+    assert "eval_occupancy" in prob.status
+    assert "eval_score_mean" in prob.status
+
+    # the compact contract cannot fuse — the method says so up front
+    prob2 = VecNE(
+        "cartpole",
+        "Linear(obs_length, 4) >> Tanh() >> Linear(4, act_length)",
+        eval_mode="episodes_compact",
+    )
+    with pytest.raises(ValueError, match="episodes_compact"):
+        prob2.make_training_span(
+            ask=_ask(8), tell=pgpe_tell, popsize=8, span=2
+        )
+
+
+# ---------------------------------------------------------------------------
+# checkpoint cadence: --checkpoint-every rounds UP to a span boundary
+# ---------------------------------------------------------------------------
+
+
+def _load_locomotion_curve():
+    path = (
+        pathlib.Path(__file__).resolve().parent.parent
+        / "examples"
+        / "locomotion_curve.py"
+    )
+    spec = importlib.util.spec_from_file_location("locomotion_curve", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_span_checkpoint_every_rounds_up():
+    mod = _load_locomotion_curve()
+    f = mod.span_checkpoint_every
+    assert f(25, 8) == 32  # not a multiple: round UP to the next boundary
+    assert f(32, 8) == 32  # already aligned: unchanged
+    assert f(1, 8) == 8  # never below one span
+    assert f(10, 1) == 10  # span 1 is the host-loop cadence

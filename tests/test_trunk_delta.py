@@ -319,8 +319,13 @@ def test_trunk_generation_step_2d_mesh():
         mesh=make_mesh({"pop": 4, "model": 2}),
         num_episodes=1, episode_length=16, eval_mode="budget",
     )
-    # the step program DONATES the input state: snapshot the center first
-    center_before = np.asarray(state.optimizer_state.center)
+    # the step program DONATES the input state: snapshot the center first.
+    # The .copy() is load-bearing — np.asarray of a CPU jax array is a
+    # zero-copy VIEW of the device buffer, and a donated program may write
+    # its output into that very buffer in place (the persistent-compile-cache
+    # deserialized executable does; a freshly compiled one happens not to),
+    # which would silently turn this "snapshot" into the post-update center.
+    center_before = np.asarray(state.optimizer_state.center).copy()
     state2, scores, stats2, steps, _telemetry = step(state, jax.random.key(1), stats)
     assert np.isfinite(np.asarray(scores)).all()
     assert int(np.asarray(steps)) == 16 * 16
