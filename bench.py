@@ -77,6 +77,19 @@ the effective ``trunk_rank`` / ``trunk_block`` (explicit
 ledger on, every line also self-describes with ``hidden`` /
 ``param_count`` / ``policy_form`` (dense / lowrank / trunk_delta).
 
+``BENCH_SERVE=1`` runs the multi-tenant SERVING A/B (evotorch_tpu/serving,
+docs/serving.md): ``BENCH_SERVE_TENANTS`` (default 4) concurrent searches,
+each popsize/T solutions per generation, packed through ONE
+``EvalServer``'s resident ``episodes_refill`` program vs the same searches
+dispatched sequentially standalone — interleaved median of
+``BENCH_SERVE_AB_REPEATS`` samples (default 3), per-tenant packed scores
+asserted bit-identical to the standalone leg during warmup. Adds
+``serve_speedup`` / ``serve_value`` / ``sequential_value`` /
+``serve_occupancy`` and the served queue-wait quantiles
+(``serve_queue_wait_p50``/``p99``, ``*_by_tenant`` lists — what
+``slo --check-bench --max-queue-wait-p99`` reads). Off by default; line
+byte-compatible.
+
 ``BENCH_COMPILE_CACHE=1`` enables the persistent XLA compilation cache
 (observability/compilecache.py; dir override ``EVOTORCH_COMPILE_CACHE_DIR``)
 and appends a ``compile_cache`` block — hit/miss counters and cold/warm
@@ -375,10 +388,13 @@ def main():
             # search-health plane (schema v4): the contract's score
             # statistics, decoded from the same wire — absent entirely
             # under BENCH_HEALTH=0 so those lines stay byte-compatible
-            stats = mode_groups.score_stats()
-            if stats["count"] > 0:
-                modes[mode]["score_mean"] = round(stats["mean"], 6)
-                modes[mode]["score_std"] = round(stats["std"], 6)
+            # NOT named `stats`: that local is the RunningNorm stats every
+            # rollout closure reads — shadowing it here hands a dict to the
+            # next mode's trace
+            sstats = mode_groups.score_stats()
+            if sstats["count"] > 0:
+                modes[mode]["score_mean"] = round(sstats["mean"], 6)
+                modes[mode]["score_std"] = round(sstats["std"], 6)
             if mode_groups.num_groups > 1:
                 rows = mode_groups.to_rows()
                 modes[mode]["score_mean_by_group"] = [
@@ -596,6 +612,144 @@ def main():
                 },
             )
 
+    serve_ab = {}
+    if cfg["serve"]:
+        # BENCH_SERVE=1: the multi-tenant serving A/B (docs/serving.md) —
+        # BENCH_SERVE_TENANTS concurrent searches, each popsize/T solutions
+        # per generation, packed through ONE EvalServer's resident
+        # episodes_refill program (the telemetry group id is the tenant id)
+        # vs the SAME searches dispatched sequentially standalone. The
+        # warmup round asserts per-tenant packed scores bit-identical to
+        # the standalone leg (same work — the speedup is pure packing and
+        # dispatch amortization). INTERLEAVED median-of-N samples
+        # (BENCH_SERVE_AB_REPEATS, default 3); both legs warm twice before
+        # the clock and every timed loop runs under the retrace sentinel —
+        # per-generation submits re-dispatch the resident program, so any
+        # steady-state compile is a retrace bug.
+        import numpy as np
+
+        from evotorch_tpu.serving import EvalServer
+
+        serve_tenants = cfg["serve_tenants"]
+        tenant_pop = max(1, popsize // serve_tenants)
+        server = EvalServer(
+            env,
+            policy,
+            slab_size=tenant_pop * serve_tenants,
+            max_tenants=serve_tenants,
+            refill_width=refill_cfg.get("refill_width"),
+            refill_period=refill_cfg.get("refill_period") or 1,
+            num_episodes=1,
+            episode_length=episode_length,
+            compute_dtype=compute_dtype,
+            health=cfg["health"],
+        )
+        handles = [server.admit(f"bench{t}") for t in range(serve_tenants)]
+        key, vkey, skey = jax.random.split(key, 3)
+        # numpy parameter matrices: what a host-side search hands the
+        # server (and ~3x cheaper per jitted dispatch than device arrays)
+        tenant_values = [
+            np.asarray(
+                jax.random.normal(
+                    jax.random.fold_in(vkey, t),
+                    (tenant_pop, policy.parameter_count),
+                ),
+                dtype=np.float32,
+            )
+            for t in range(serve_tenants)
+        ]
+        tenant_keys = [jax.random.fold_in(skey, t) for t in range(serve_tenants)]
+
+        def standalone_run(values, k):
+            result = run_vectorized_rollout(
+                env, policy, values, k, None,
+                eval_mode="episodes_refill",
+                num_episodes=1,
+                episode_length=episode_length,
+                compute_dtype=compute_dtype,
+                telemetry=True,
+                health=cfg["health"],
+            )
+            return result.scores, result.total_steps
+
+        standalone_fn = jax.jit(standalone_run)
+
+        def serve_sample():
+            futures = [
+                server.submit(handles[t], tenant_values[t], key=tenant_keys[t])
+                for t in range(serve_tenants)
+            ]
+            server.drain()
+            results = [f.result() for f in futures]
+            steps = sum(int(r.total_steps) for r in results)
+            return steps, [np.asarray(r.scores) for r in results]
+
+        def sequential_sample():
+            steps = 0
+            all_scores = []
+            for t in range(serve_tenants):
+                scores, st = standalone_fn(tenant_values[t], tenant_keys[t])
+                jax.block_until_ready(scores)
+                steps += int(st)
+                all_scores.append(np.asarray(scores))
+            return steps, all_scores
+
+        serve_runs = {"serve": serve_sample, "sequential": sequential_sample}
+        warm_scores = {}
+        for leg, sampler in serve_runs.items():
+            sampler()  # compile
+            _, warm_scores[leg] = sampler()  # steady state
+        for t in range(serve_tenants):
+            if not np.array_equal(
+                warm_scores["serve"][t], warm_scores["sequential"][t]
+            ):
+                raise SystemExit(
+                    f"serve A/B: tenant {t} packed scores diverged from the"
+                    " standalone leg — tenant isolation bug"
+                )
+        serve_samples = {leg: [] for leg in serve_runs}
+        for _ in range(cfg["serve_ab_repeats"]):
+            for leg, sampler in serve_runs.items():
+                with track_compiles() as compile_log:
+                    t0 = time.perf_counter()
+                    sample_steps, _ = sampler()
+                    elapsed = time.perf_counter() - t0
+                steady_compiles += compile_log.count
+                serve_samples[leg].append(sample_steps / elapsed)
+        med_serve = {
+            leg: statistics.median(s) for leg, s in serve_samples.items()
+        }
+        print(
+            f"[serve_ab] {serve_tenants} tenants x {tenant_pop},"
+            f" {cfg['serve_ab_repeats']} interleaved samples: sequential"
+            f" {med_serve['sequential']:.0f} vs served"
+            f" {med_serve['serve']:.0f} steps/s"
+            f" ({med_serve['serve'] / med_serve['sequential']:.2f}x),"
+            f" occupancy {server.occupancy():.3f}",
+            file=sys.stderr,
+        )
+        tenant_rows = [h.telemetry for h in handles]
+        merged_row = tenant_rows[0]
+        for row in tenant_rows[1:]:
+            merged_row = merged_row + row
+        serve_ab = {
+            "serve_tenants": serve_tenants,
+            "serve_speedup": round(
+                med_serve["serve"] / med_serve["sequential"], 3
+            ),
+            "serve_value": round(med_serve["serve"], 1),
+            "sequential_value": round(med_serve["sequential"], 1),
+            "serve_occupancy": round(server.occupancy(), 4),
+            "serve_queue_wait_p50": merged_row.queue_wait_quantile(0.5),
+            "serve_queue_wait_p99": merged_row.queue_wait_quantile(0.99),
+            "serve_queue_wait_p50_by_tenant": [
+                row.queue_wait_quantile(0.5) for row in tenant_rows
+            ],
+            "serve_queue_wait_p99_by_tenant": [
+                row.queue_wait_quantile(0.99) for row in tenant_rows
+            ],
+        }
+
     primary = modes[eval_mode]
     # the episodes-contract headline is the best runner of that contract
     episodes_runners = [
@@ -680,6 +834,10 @@ def main():
         line["trunk_block"] = trunk_cfg["trunk_block"]
         if cfg["tuned"]:
             line["trunk_config_source"] = trunk_src
+    if cfg["serve"]:
+        # BENCH_SERVE=1 only: the multi-tenant serving A/B columns
+        # (absent by default, so the default line stays byte-compatible)
+        line.update(serve_ab)
     if cfg["span"] is not None:
         # BENCH_SPAN only: the fused-span A/B columns (absent by default,
         # so the default line stays byte-compatible with PR-18 output)

@@ -125,6 +125,16 @@ def bench_config(use_cpu: bool, *, cpu_episode_length: int = 100) -> dict:
         # (`span_ab_mode` says which contract was measured).
         "span": os.environ.get("BENCH_SPAN"),
         "span_ab_repeats": int(os.environ.get("BENCH_SPAN_AB_REPEATS", "3")),
+        # BENCH_SERVE=1 runs the multi-tenant serving A/B
+        # (evotorch_tpu/serving, docs/serving.md): BENCH_SERVE_TENANTS
+        # concurrent searches packed through ONE EvalServer's resident
+        # episodes_refill program vs the same searches dispatched
+        # sequentially standalone (`serve_speedup` on the line, plus
+        # `serve_occupancy` and the per-tenant queue-wait quantiles).
+        # Off by default, line byte-compatible.
+        "serve": os.environ.get("BENCH_SERVE", "0") == "1",
+        "serve_tenants": int(os.environ.get("BENCH_SERVE_TENANTS", "4")),
+        "serve_ab_repeats": int(os.environ.get("BENCH_SERVE_AB_REPEATS", "3")),
         "env_name": os.environ.get("BENCH_ENV", "humanoid"),
         "env_kwargs": json.loads(os.environ.get("BENCH_ENV_ARGS", "{}")),
         # lane-compaction tuning (episodes_compact only): chunk size between

@@ -26,7 +26,15 @@ import numpy as np
 
 from ...tools.pytree import pytree_dataclass
 
-__all__ = ["CollectedStats", "RunningNorm", "RunningStat"]
+__all__ = [
+    "CollectedStats",
+    "RunningNorm",
+    "RunningStat",
+    "group_stats_init",
+    "group_stats_normalize",
+    "group_stats_update",
+    "stats_slot",
+]
 
 
 @pytree_dataclass
@@ -96,6 +104,80 @@ def stats_normalize(stats: CollectedStats, obs: jnp.ndarray, *, clip: Optional[T
         lo, hi = clip
         normalized = jnp.clip(normalized, lo, hi)
     return jnp.where(safe, normalized, obs)
+
+
+# -------------------- per-group (stacked) statistics ------------------------
+# A STACKED CollectedStats — count (G,), sum (G, n), sum_of_squares (G, n) —
+# holds one independent observation-normalization slot per accounting group
+# (tenant, island, ...). The refill rollout engine detects the stacked form
+# by the count's rank and switches every stat touch to these helpers, so N
+# tenants sharing one compiled program each normalize by THEIR OWN history
+# (per-tenant obs-norm isolation, docs/serving.md). The leaves stay plain
+# arrays, so psum/merge/checkpoint plumbing lifts unchanged.
+
+
+def group_stats_init(num_groups: int, n: int, dtype=jnp.float32) -> CollectedStats:
+    """A stacked stats pytree with ``num_groups`` independent zero slots."""
+    return CollectedStats(
+        count=jnp.zeros(int(num_groups), dtype=dtype),
+        sum=jnp.zeros((int(num_groups), n), dtype=dtype),
+        sum_of_squares=jnp.zeros((int(num_groups), n), dtype=dtype),
+    )
+
+
+def group_stats_update(
+    stats: CollectedStats,
+    obs: jnp.ndarray,
+    groups: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    num_groups: int,
+) -> CollectedStats:
+    """Accumulate a batch of observations ``(B, n)`` into stacked stats,
+    crediting row ``i`` to slot ``groups[i]`` (masked rows contribute
+    nothing — the same masking contract as :func:`stats_update`). Pure;
+    usable inside jit/scan."""
+    obs = jnp.atleast_2d(obs)
+    groups = jnp.asarray(groups, dtype=jnp.int32)
+    if mask is not None:
+        m = mask.astype(obs.dtype)
+    else:
+        m = jnp.ones(obs.shape[0], dtype=obs.dtype)
+    obs_m = obs * m[:, None]
+    return CollectedStats(
+        count=stats.count
+        + jax.ops.segment_sum(m, groups, num_segments=int(num_groups)),
+        sum=stats.sum
+        + jax.ops.segment_sum(obs_m, groups, num_segments=int(num_groups)),
+        sum_of_squares=stats.sum_of_squares
+        + jax.ops.segment_sum(obs_m**2, groups, num_segments=int(num_groups)),
+    )
+
+
+def group_stats_normalize(
+    stats: CollectedStats, obs: jnp.ndarray, groups: jnp.ndarray
+) -> jnp.ndarray:
+    """Normalize each observation row by ITS group's slot (identity while
+    that slot's count < 2) — the per-lane gather form of
+    :func:`stats_normalize` over stacked stats."""
+    groups = jnp.asarray(groups, dtype=jnp.int32)
+    cnt = jnp.maximum(stats.count, 1.0)[:, None]
+    mean = stats.sum / cnt
+    c2 = jnp.maximum(stats.count, 2.0)[:, None]
+    var = (stats.sum_of_squares - (stats.sum**2) / c2) / (c2 - 1.0)
+    stdev = jnp.sqrt(jnp.maximum(var, 1e-8))
+    safe = (stats.count >= 2.0)[groups]
+    normalized = (obs - mean[groups]) / stdev[groups]
+    return jnp.where(safe[:, None], normalized, obs)
+
+
+def stats_slot(stats: CollectedStats, g: int) -> CollectedStats:
+    """One group's slot of a stacked stats pytree as a plain (unstacked)
+    :class:`CollectedStats` — what a tenant sees as "its" statistics."""
+    return CollectedStats(
+        count=stats.count[g],
+        sum=stats.sum[g],
+        sum_of_squares=stats.sum_of_squares[g],
+    )
 
 
 class RunningNorm:

@@ -58,7 +58,13 @@ from ..net.lowrank import (
     trunk_delta_forward,
 )
 from ..net.rl import alive_bonus_for_step
-from ..net.runningnorm import CollectedStats, stats_normalize, stats_update
+from ..net.runningnorm import (
+    CollectedStats,
+    group_stats_normalize,
+    group_stats_update,
+    stats_normalize,
+    stats_update,
+)
 
 __all__ = [
     "Policy",
@@ -798,6 +804,7 @@ def run_vectorized_rollout(
     compute_dtype=None,
     eval_mode: str = "episodes",
     lane_ids=None,
+    solution_keys=None,
     stats_sync_axis: Optional[str] = None,
     refill_width: Optional[int] = None,
     refill_period: int = 1,
@@ -863,6 +870,25 @@ def run_vectorized_rollout(
     queue-wait histograms (log-spaced buckets; see
     ``devicemetrics.QUEUE_WAIT_BUCKET_EDGES``) fed by each refilled item's
     idle-to-refill wait.
+
+    ``solution_keys`` (``episodes_refill`` only): an optional TRACED ``(N,)``
+    typed-key array of per-solution BASE keys. When given, the (solution,
+    episode) item seeds fold into ``solution_keys[s]`` instead of the global
+    ``key`` — so solutions owned by different requests/tenants packed into
+    one program each reproduce the realized randomness of their owner's own
+    standalone evaluation (``fold_in(solution_keys[s], lane_ids[s])``
+    equals the standalone engine's ``fold_in(key_s, i)`` when the packer
+    sets ``lane_ids`` to owner-local indices). Being traced, per-dispatch
+    key/owner churn never retraces (the multi-tenant serving substrate,
+    docs/serving.md).
+
+    Per-group observation normalization (``episodes_refill`` +
+    ``groups``/``num_groups`` only): passing a STACKED stats pytree —
+    ``count (G,)``, ``sum (G, n)``, ``sum_of_squares (G, n)``, e.g.
+    ``runningnorm.group_stats_init`` — switches every stat touch to the
+    per-group form: each lane normalizes by ITS group's slot and updates
+    only that slot (per-tenant obs-norm isolation). The stacked form is
+    detected by the count's rank, so the same traced signature serves both.
 
     Randomness is a PER-LANE property: lane ``i``'s PRNG chain is seeded by
     ``fold_in(key, lane_ids[i])`` (default ``lane_ids = arange(N)``) and
@@ -953,6 +979,13 @@ def run_vectorized_rollout(
     max_t = env.max_episode_steps if env.max_episode_steps is not None else 1000
     if episode_length is not None:
         max_t = min(max_t, int(episode_length))
+    stacked_stats = stats is not None and getattr(stats.count, "ndim", 0) == 1
+    if (solution_keys is not None or stacked_stats) and eval_mode != "episodes_refill":
+        raise ValueError(
+            "solution_keys and stacked (per-group) stats are"
+            " episodes_refill-only features (the serving substrate),"
+            f" got eval_mode={eval_mode!r}"
+        )
     if eval_mode == "episodes_refill":
         return _run_refill(
             env,
@@ -968,6 +1001,7 @@ def run_vectorized_rollout(
             action_noise_stdev=action_noise_stdev,
             compute_dtype=compute_dtype,
             lane_ids=lane_ids,
+            solution_keys=solution_keys,
             stats_sync_axis=stats_sync_axis,
             refill_width=refill_width,
             refill_period=refill_period,
@@ -1301,6 +1335,7 @@ def _run_refill(
     action_noise_stdev,
     compute_dtype,
     lane_ids,
+    solution_keys,
     stats_sync_axis,
     refill_width,
     refill_period,
@@ -1327,6 +1362,10 @@ def _run_refill(
         # — and wrapping preserves the key bits, so matched-seed
         # bit-identity to it holds for legacy keys too.
         key = jax.random.wrap_key_data(key)
+    if solution_keys is not None and not jnp.issubdtype(
+        solution_keys.dtype, jax.dtypes.prng_key
+    ):
+        solution_keys = jax.random.wrap_key_data(solution_keys)
     n = _params_popsize(params_batch)
     # under width padding (num_valid < n) the work queue only enumerates the
     # genuine solutions: padding rows never receive items, so their eps_buf
@@ -1350,6 +1389,23 @@ def _run_refill(
         jnp.asarray(groups, dtype=jnp.int32) if collect_groups else None
     )
 
+    # stacked (per-group) observation-normalization slots: detected by the
+    # count's rank so the traced signature is the discriminator (an aval
+    # rank change is a different program anyway — no new static argument)
+    stacked_stats = stats is not None and getattr(stats.count, "ndim", 0) == 1
+    if stacked_stats:
+        if not collect_groups:
+            raise ValueError(
+                "stacked (per-group) stats require telemetry plus a groups"
+                " array with num_groups > 1 — each slot needs lane->group"
+                " bindings to credit"
+            )
+        if stats.count.shape[0] != int(num_groups):
+            raise ValueError(
+                f"stacked stats carry {stats.count.shape[0]} slots but"
+                f" num_groups={num_groups}"
+            )
+
     def item_keys(items):
         """(chain, reset) PRNG keys + solution index of queue items. Episode
         ``e`` of solution ``s`` is seeded ``fold_in(key, lane_ids[s] +
@@ -1358,11 +1414,16 @@ def _run_refill(
         bit-for-bit at ``num_episodes=1`` (observation normalization off —
         see the ``run_vectorized_rollout`` docstring), for ANY width,
         sharded or not (``seed_stride`` must be the GLOBAL popsize on a
-        sharded caller)."""
+        sharded caller). With ``solution_keys``, each item folds its seed
+        into ITS solution's base key instead of the shared ``key`` — the
+        per-tenant isolation form (see ``run_vectorized_rollout``)."""
         sol = items % nv
         ep = items // nv
         seeds = lane_ids[sol] + ep * jnp.int32(stride)
-        ik = jax.vmap(lambda s: jax.random.fold_in(key, s))(seeds)
+        if solution_keys is not None:
+            ik = jax.vmap(jax.random.fold_in)(solution_keys[sol], seeds)
+        else:
+            ik = jax.vmap(lambda s: jax.random.fold_in(key, s))(seeds)
         pair = jax.vmap(lambda k: jax.random.split(k, 2))(ik)
         return pair[:, 0], pair[:, 1], sol
 
@@ -1370,7 +1431,14 @@ def _run_refill(
     chain0, reset0, sol0 = item_keys(items0)
     env_states0, obs0 = _env_reset(env, reset0)
     if observation_normalization:
-        new_stats = stats_update(stats, obs0, mask=jnp.ones(width, dtype=bool))
+        if stacked_stats:
+            new_stats = group_stats_update(
+                stats, obs0, groups_arr[sol0], None, int(num_groups)
+            )
+        else:
+            new_stats = stats_update(
+                stats, obs0, mask=jnp.ones(width, dtype=bool)
+            )
         if stats_sync_axis is not None:
             new_stats = _stats_psum_merge(stats, new_stats, stats_sync_axis)
         stats = new_stats
@@ -1428,9 +1496,14 @@ def _run_refill(
         else:
             lane_keys, noise_keys = c.key, None
 
-        policy_in = (
-            stats_normalize(c.stats, c.obs) if observation_normalization else c.obs
-        )
+        if not observation_normalization:
+            policy_in = c.obs
+        elif stacked_stats:
+            # per-group slots: each lane is normalized by ITS group's
+            # running statistics (tenant isolation)
+            policy_in = group_stats_normalize(c.stats, c.obs, c.lane_groups)
+        else:
+            policy_in = stats_normalize(c.stats, c.obs)
         if compute_dtype is not None:
             policy_in = policy_in.astype(compute_dtype)
         raw, new_policy_states = forward(c.lane_params, policy_in, c.policy_states)
@@ -1596,12 +1669,17 @@ def _run_refill(
 
         # obs-norm statistics count ONLY live-lane observations: the
         # post-refill obs each still-active lane will consume next step
-        # (idle/drained lanes are masked out entirely)
-        new_stats = (
-            stats_update(c.stats, obs_next, mask=active)
-            if observation_normalization
-            else c.stats
-        )
+        # (idle/drained lanes are masked out entirely). Stacked slots
+        # credit the POST-refill lane groups: a fresh reset observation
+        # belongs to the incoming item's group, not the departed one's.
+        if not observation_normalization:
+            new_stats = c.stats
+        elif stacked_stats:
+            new_stats = group_stats_update(
+                c.stats, obs_next, lane_groups, active, num_groups
+            )
+        else:
+            new_stats = stats_update(c.stats, obs_next, mask=active)
         if observation_normalization and stats_sync_axis is not None:
             new_stats = _stats_psum_merge(c.stats, new_stats, stats_sync_axis)
 

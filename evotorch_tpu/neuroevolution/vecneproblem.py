@@ -66,6 +66,7 @@ class VecNE(NEProblem):
         health_telemetry: bool = True,
         nonfinite_quarantine: bool = True,
         nonfinite_penalty: Optional[float] = None,
+        eval_backend=None,
         compute_dtype=None,
         initial_bounds=(-0.00001, 0.00001),
         seed: Optional[int] = None,
@@ -222,6 +223,31 @@ class VecNE(NEProblem):
         self._max_num_envs = None if max_num_envs is None else int(max_num_envs)
         # bfloat16 (etc.) policy compute for the MXU fast path
         self._compute_dtype = compute_dtype
+        # shared evaluation service (docs/serving.md): with an eval_backend —
+        # a serving.RemoteEvalBackend, or a serving.EvalServer to auto-admit
+        # into — every rollout dispatch routes through the server's ONE
+        # resident multi-tenant program instead of compiling this problem's
+        # own; searchers and every consumer downstream of RolloutResult are
+        # unaffected. The backend path owns the device program, so it is
+        # mutually exclusive with the problem-local mesh request
+        # (num_actors) and with solution_groups (the server's group axis IS
+        # the tenant axis).
+        if eval_backend is not None:
+            from ..serving import EvalServer, RemoteEvalBackend
+
+            if isinstance(eval_backend, EvalServer):
+                eval_backend = RemoteEvalBackend(eval_backend)
+            if not isinstance(eval_backend, RemoteEvalBackend):
+                raise TypeError(
+                    "eval_backend must be a serving.RemoteEvalBackend or"
+                    f" serving.EvalServer, got {type(eval_backend).__name__}"
+                )
+            if self._solution_groups is not None:
+                raise ValueError(
+                    "solution_groups cannot combine with eval_backend: the"
+                    " server's group axis is the tenant axis"
+                )
+        self._eval_backend = eval_backend
 
         self._obs_norm = RunningNorm(self._env.observation_size)
         self._interaction_count = 0
@@ -268,6 +294,11 @@ class VecNE(NEProblem):
     @property
     def obs_norm(self) -> RunningNorm:
         return self._obs_norm
+
+    @property
+    def eval_backend(self):
+        """The attached RemoteEvalBackend (None when evaluating locally)."""
+        return self._eval_backend
 
     @property
     def last_group_telemetry(self):
@@ -433,6 +464,8 @@ class VecNE(NEProblem):
 
     # ------------------------------------------------------------ evaluation
     def _rollout_batch(self, values: jnp.ndarray, key, groups=None) -> tuple:
+        if self._eval_backend is not None:
+            return self._eval_backend.evaluate(self, values, key, groups=groups)
         kwargs = dict(
             num_episodes=self._num_episodes,
             episode_length=self._episode_length,
@@ -500,7 +533,11 @@ class VecNE(NEProblem):
         return default_mesh(("pop",), devices=jax.devices()[:n])
 
     def _evaluate_batch(self, batch: SolutionBatch):
-        mesh = self._num_actors_mesh(len(batch))
+        # the backend path owns the device program — the local mesh request
+        # does not apply through it (the SERVER may be meshed instead)
+        mesh = (
+            None if self._eval_backend is not None else self._num_actors_mesh(len(batch))
+        )
         if mesh is not None:
             self.evaluate_sharded(batch, mesh=mesh)
             return

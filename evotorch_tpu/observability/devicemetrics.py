@@ -589,6 +589,32 @@ class GroupTelemetry:
             )
         return " ".join(parts)
 
+    def to_wire(self) -> np.ndarray:
+        """Re-pack into the int32 wire matrix — ``(G,
+        HEALTH_TELEMETRY_WIDTH)`` with the health block bit-cast back when
+        this decode carried one, else ``(G, GROUP_TELEMETRY_WIDTH)``.
+        Decode → combine (``__add__``) → re-pack is lossless for the
+        counter block and float32-exact for the health block, which is how
+        host-side consumers that accumulate rows across dispatches (the
+        serving backend merging a request's per-dispatch tenant rows) hand
+        a standard wire back to ``from_array`` consumers."""
+        counter = np.asarray(self.data, dtype=np.int64)
+        if np.any(counter > np.iinfo(np.int32).max) or np.any(
+            counter < np.iinfo(np.int32).min
+        ):
+            raise OverflowError(
+                "accumulated telemetry counters exceed the int32 wire range"
+            )
+        wire = counter.astype(np.int32)
+        if self.health is None:
+            return wire
+        bits = (
+            np.asarray(self.health, dtype=np.float32)
+            .view(np.int32)
+            .reshape(self.num_groups, HEALTH_WIDTH)
+        )
+        return np.concatenate([wire, bits], axis=1)
+
     def to_rows(self) -> Tuple[dict, ...]:
         """JSON-safe per-group rows for the MetricsHub stream."""
         rows = []

@@ -375,6 +375,7 @@ def check_bench_line(
     max_nonfinite_share: Optional[float] = None,
     max_score_collapse: Optional[float] = None,
     min_score_snr: Optional[float] = None,
+    max_queue_wait_p99: Optional[float] = None,
 ) -> SLOReport:
     """Apply the battery rules to one decoded bench.py JSON line.
 
@@ -394,9 +395,29 @@ def check_bench_line(
     its mean scale — stdev-collapse seen from the score side);
     ``min_score_snr`` fails when the SNR is below the floor (the scores
     are noise-dominated). Lines without the columns skip both.
+
+    ``max_queue_wait_p99`` gates the tail of the refill queue-wait
+    distribution (in loop steps, from the on-device histograms): the
+    top-level ``queue_wait_p99``, every per-mode one under ``modes``, and
+    the serving A/B's ``serve_queue_wait_p99`` (a BENCH_SERVE=1 line) must
+    each stay at or below the ceiling — the multi-tenant fairness gate.
+    Lines without the columns skip the check.
     """
     violations = []
     checked = 0
+
+    def _check_queue_wait(value, label):
+        nonlocal checked
+        if max_queue_wait_p99 is None or value is None:
+            return
+        checked += 1
+        if float(value) > max_queue_wait_p99:
+            violations.append(
+                f"{label}queue_wait_p99={float(value):g} > {max_queue_wait_p99:g}"
+            )
+
+    _check_queue_wait(line.get("queue_wait_p99"), "")
+    _check_queue_wait(line.get("serve_queue_wait_p99"), "serve_")
     compiles = line.get("steady_compiles")
     if compiles is not None:
         checked += 1
@@ -464,6 +485,7 @@ def check_bench_line(
         _check_health(
             rec.get("score_mean"), rec.get("score_std"), f"modes.{mode}."
         )
+        _check_queue_wait(rec.get("queue_wait_p99"), f"modes.{mode}.")
     return SLOReport(ok=not violations, violations=tuple(violations), checked=checked)
 
 
@@ -534,6 +556,14 @@ def _main(argv=None) -> int:
         "it the scores are noise-dominated (default: unchecked)",
     )
     parser.add_argument(
+        "--max-queue-wait-p99",
+        type=float,
+        default=None,
+        help="maximum acceptable refill queue-wait p99 (loop steps), "
+        "top-level, per contract and for the serving A/B "
+        "(default: unchecked; needs histogrammed refill events)",
+    )
+    parser.add_argument(
         "--verdict-out",
         metavar="PATH",
         default=None,
@@ -552,6 +582,7 @@ def _main(argv=None) -> int:
             max_nonfinite_share=args.max_nonfinite_share,
             max_score_collapse=args.max_score_collapse,
             min_score_snr=args.min_score_snr,
+            max_queue_wait_p99=args.max_queue_wait_p99,
         )
     if report.checked == 0:
         # no decodable line, or a line with none of the checked keys (e.g.

@@ -40,10 +40,36 @@ occupancy column proving the default refill width mistuned on this box).
 from __future__ import annotations
 
 import json
+import os
 import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def _atomic_write_json(path: Path, payload: dict) -> None:
+    """Durable atomic JSON write: temp file in the target directory +
+    flush + fsync + ``os.replace``, retried on transient IO errors
+    (``resilience.retry``, site ``timings.write`` — fault-injectable).
+    Concurrent searches sharing one eval server can race the autotuner's
+    read-modify-write; whatever interleaving loses the race, a reader
+    only ever sees a COMPLETE old or new file, never a truncation."""
+    from ..resilience.retry import retry_call
+
+    def _write() -> None:
+        tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if tmp.exists():
+                tmp.unlink()
+
+    retry_call(_write, site="timings.write")
 
 __all__ = [
     "SOURCE_CACHE",
@@ -261,9 +287,9 @@ class TimingLedger:
 
     def save(self, path) -> Path:
         path = Path(path)
-        with open(path, "w") as f:
-            json.dump(self.to_json(), f, indent=2, sort_keys=True)
-            f.write("\n")
+        # atomic (temp + fsync + replace): a --timings-out dump killed
+        # mid-write must not leave a truncated ledger
+        _atomic_write_json(path, self.to_json())
         return path
 
     @classmethod
@@ -421,12 +447,12 @@ def lookup_tuned(
 def save_tuned_entry(entry: TunedEntry, path=None) -> Path:
     """Persist one winner (last write per key wins) and refresh the
     in-process memo so the running process sees its own tuning. The write
-    is ATOMIC (temp file + rename): a battery step killed mid-write (the
-    tpu_window timeout, a dropped tunnel) must not leave a truncated
-    checked-in cache that silently downgrades every consumer to
+    is ATOMIC AND DURABLE (per-pid temp file + fsync + rename, retried on
+    transient IO errors): a battery step killed mid-write (the tpu_window
+    timeout, a dropped tunnel) or concurrent searches racing the
+    read-modify-write through a shared eval server must not leave a
+    truncated checked-in cache that silently downgrades every consumer to
     fallback."""
-    import os
-
     target = Path(path) if path is not None else default_tuned_cache_path()
     entries = dict(load_tuned_cache(target, force=True))
     entries[entry.key] = entry
@@ -437,11 +463,7 @@ def save_tuned_entry(entry: TunedEntry, path=None) -> Path:
         "version": 2,
         "entries": [entries[k].to_json() for k in sorted(entries)],
     }
-    tmp = target.with_name(target.name + ".tmp")
-    with open(tmp, "w") as f:
-        json.dump(payload, f, indent=2, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, target)
+    _atomic_write_json(target, payload)
     load_tuned_cache(target, force=True)
     return target
 

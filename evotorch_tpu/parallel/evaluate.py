@@ -38,6 +38,7 @@ _EVALUATOR_CACHE_SIZE = 64
 
 __all__ = [
     "make_generation_step",
+    "make_resident_rollout_program",
     "make_sharded_evaluator",
     "make_sharded_rollout_evaluator",
     "make_training_span",
@@ -286,6 +287,80 @@ def _lookup_refill_config(env, policy, mesh, rollout_kwargs, popsize):
             local_kwargs["refill_period"] = int(entry.config["period"])
         return local_kwargs, SOURCE_CACHE
     return local_kwargs, SOURCE_FALLBACK
+
+
+def make_resident_rollout_program(
+    env,
+    policy,
+    *,
+    mesh: Optional[Mesh] = None,
+    **rollout_kwargs,
+):
+    """A long-lived handle on ONE compiled ``episodes_refill`` rollout
+    program — the serving substrate (``evotorch_tpu.serving``,
+    docs/serving.md).
+
+    Everything that would retrace — the env, the policy shape, the eval
+    contract, the lane width/period, the group-row count, the mesh layout —
+    is fixed here, at handle construction; every per-dispatch quantity that
+    changes as tenants come and go — the packed parameter slab, the
+    per-solution base keys (``solution_keys``), the owner-local
+    ``lane_ids``, the tenant→group binding (``groups``), the obs-norm
+    stats — is TRACED, so admission/departure churn re-dispatches the same
+    resident executable (steady_compiles == 0; the retrace sentinel
+    enforces it in the serving tests).
+
+    With a ``mesh``, the slab is pinned to ``population_spec(mesh)`` inside
+    the program (GSPMD — the global program is the unsharded program, so
+    packing semantics and scores are mesh-independent). Call as
+    ``program(values, key, stats, lane_ids, groups, solution_keys)``;
+    ``program.key`` is the residency identity, ``program.dispatches``
+    counts calls."""
+    from ..neuroevolution.net.vecrl import run_vectorized_rollout
+
+    rollout_kwargs.setdefault("eval_mode", "episodes_refill")
+    if rollout_kwargs["eval_mode"] != "episodes_refill":
+        raise ValueError(
+            "make_resident_rollout_program serves the episodes_refill"
+            f" contract only, got eval_mode={rollout_kwargs['eval_mode']!r}"
+        )
+
+    def _run(values, key, stats, lane_ids, groups, solution_keys):
+        if mesh is not None:
+            values = _constrain_population(values, mesh)
+        return run_vectorized_rollout(
+            env,
+            policy,
+            values,
+            key,
+            stats,
+            lane_ids=lane_ids,
+            groups=groups,
+            solution_keys=solution_keys,
+            **rollout_kwargs,
+        )
+
+    # one closure-jitted program: no static arguments at THIS layer means
+    # the only thing that can retrace is an aval change — exactly the
+    # residency contract (slab shape fixed ⇒ executable fixed)
+    fn = jax.jit(_run)
+
+    def program(values, key, stats, lane_ids, groups, solution_keys):
+        program.dispatches += 1
+        return fn(values, key, stats, lane_ids, groups, solution_keys)
+
+    from ..observability.timings import canonical_env_label, dtype_label
+
+    program.dispatches = 0
+    program.key = (
+        canonical_env_label(env),
+        int(policy.parameter_count),
+        str(rollout_kwargs["eval_mode"]),
+        rollout_kwargs.get("refill_width"),
+        mesh_label(mesh) if mesh is not None else "none",
+        dtype_label(rollout_kwargs.get("compute_dtype")),
+    )
+    return program
 
 
 def make_sharded_rollout_evaluator(

@@ -1,0 +1,29 @@
+"""Multi-tenant continuous-batching evaluation service (docs/serving.md).
+
+The front door the refill engine was built for: one long-running
+:class:`EvalServer` keeps ONE compiled ``episodes_refill`` rollout program
+resident and packs (solution, episode) items from many concurrent searches
+into its fixed-width device loop — continuous batching where the telemetry
+group id is the tenant id. ``RemoteEvalBackend`` plugs an unmodified
+``VecNE`` into a shared server (``eval_backend=``); ``python -m
+evotorch_tpu.serving`` is the JSONL-over-stdio front for out-of-process
+clients.
+"""
+
+from .admission import AdmissionPolicy, FIFOAdmission, StarvationAwareAdmission
+from .backend import RemoteEvalBackend
+from .requests import EvalFuture, EvalRequest
+from .server import EvalServer, Tenant
+from .stdio import serve_stdio
+
+__all__ = [
+    "AdmissionPolicy",
+    "EvalFuture",
+    "EvalRequest",
+    "EvalServer",
+    "FIFOAdmission",
+    "RemoteEvalBackend",
+    "StarvationAwareAdmission",
+    "Tenant",
+    "serve_stdio",
+]

@@ -1,0 +1,142 @@
+"""The VecNE-side adapter: evaluate an unmodified problem through a server.
+
+``VecNE(..., eval_backend=RemoteEvalBackend(server))`` (or
+``eval_backend=server`` — the problem wraps it) reroutes every generation's
+rollout dispatch through the shared :class:`~evotorch_tpu.serving.EvalServer`
+instead of compiling the problem's own program: the backend admits itself as
+a tenant, submits each batch under the problem's OWN next PRNG key, drives
+the server until the request completes (serving other tenants' items along
+the way — that is the sharing), and hands back a ``RolloutResult`` the
+problem consumes exactly as it consumes its standalone engines' — scores,
+obs-norm stats (the tenant's slot), step/episode counters and a standard
+telemetry wire. Searchers never know.
+
+Bit-identity: at ``num_episodes == 1`` without observation normalization,
+the scores a problem sees through the backend are bit-identical to the
+scores the same problem computes standalone with the same seed — the
+engine's per-item key derivation is packing-invariant (docs/serving.md
+"Isolation").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .server import EvalServer, Tenant
+
+__all__ = ["RemoteEvalBackend"]
+
+
+class RemoteEvalBackend:
+    """Adapter between one ``VecNE`` problem and a shared :class:`EvalServer`.
+
+    One backend = one tenant. Construct with a server (the backend admits
+    itself, optionally under ``name``) or with an existing tenant handle.
+    ``close()`` departs the tenant and frees its group row.
+    """
+
+    def __init__(
+        self,
+        server: EvalServer,
+        *,
+        tenant: Optional[Tenant] = None,
+        name: Optional[str] = None,
+    ):
+        if not isinstance(server, EvalServer):
+            raise TypeError(
+                f"server must be an EvalServer, got {type(server).__name__}"
+            )
+        self.server = server
+        self.tenant = tenant if tenant is not None else server.admit(name=name)
+        self._checked_for = None
+
+    # ------------------------------------------------------------- lifecycle
+    def close(self, *, cancel: bool = False) -> None:
+        self.server.depart(self.tenant, cancel=cancel)
+
+    def __enter__(self) -> "RemoteEvalBackend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(cancel=True)
+
+    # ------------------------------------------------------------ validation
+    def _check_problem(self, problem) -> None:
+        """The residency contract: the server runs ONE program, so every
+        attached problem must describe the same eval contract. Checked once
+        per problem (the attributes are construction-time constants)."""
+        if self._checked_for is problem:
+            return
+        server = self.server
+        mismatches = []
+        if problem._policy.parameter_count != server.policy.parameter_count:
+            mismatches.append(
+                f"parameter_count {problem._policy.parameter_count} !="
+                f" {server.policy.parameter_count}"
+            )
+        if problem._env.observation_size != server.env.observation_size:
+            mismatches.append("observation_size differs")
+        if problem._env.action_size != server.env.action_size:
+            mismatches.append("action_size differs")
+        if problem._num_episodes != server.num_episodes:
+            mismatches.append(
+                f"num_episodes {problem._num_episodes} != {server.num_episodes}"
+            )
+        if problem._episode_length != (
+            None if server.episode_length is None else int(server.episode_length)
+        ):
+            mismatches.append(
+                f"episode_length {problem._episode_length} != {server.episode_length}"
+            )
+        if problem._observation_normalization != server.observation_normalization:
+            mismatches.append("observation_normalization differs")
+        if problem._eval_mode == "budget":
+            mismatches.append(
+                "the server serves the episodes contract; a budget-contract"
+                " problem cannot evaluate through it"
+            )
+        if mismatches:
+            raise ValueError(
+                "problem is incompatible with the server's resident program: "
+                + "; ".join(mismatches)
+            )
+        self._checked_for = problem
+
+    # ------------------------------------------------------------- evaluate
+    def evaluate(self, problem, values, key, groups=None):
+        """One generation's rollout dispatch, served remotely. Matches the
+        ``RolloutResult`` contract of ``problem._rollout_batch``."""
+        from ..tools.lowrank import is_factored
+
+        if groups is not None:
+            raise ValueError(
+                "solution_groups cannot ride through a RemoteEvalBackend:"
+                " the server's group axis IS the tenant axis"
+            )
+        if is_factored(values):
+            raise ValueError(
+                "factored (low-rank / trunk-delta) populations are not"
+                " servable yet; densify or evaluate standalone"
+            )
+        self._check_problem(problem)
+        stats = (
+            problem._obs_norm.stats if problem._observation_normalization else None
+        )
+        future = self.server.submit(
+            self.tenant, np.asarray(values), key, stats=stats
+        )
+        result = future.result()
+        if problem._nonfinite_quarantine:
+            # standalone semantics, tenant-locally: the worst-finite (or
+            # fixed-penalty) replacement pool is THIS tenant's scores only —
+            # the server never applies the batch-worst rule across a
+            # multi-tenant slab (docs/serving.md "Isolation")
+            from ..neuroevolution.net.vecrl import _quarantine_nonfinite
+
+            scores, _ = _quarantine_nonfinite(
+                result.scores, penalty=problem._nonfinite_penalty
+            )
+            result = result._replace(scores=scores)
+        return result

@@ -1,0 +1,118 @@
+"""Request/future plumbing of the evaluation service.
+
+One :class:`EvalRequest` is one tenant's "evaluate these N solutions" call.
+The server slices it into per-solution *items*, packs items from many
+requests into fixed-width ``episodes_refill`` slabs, and assembles each
+request's scores back as its items finish — across as many device dispatches
+as packing needs. The client-facing handle is the :class:`EvalFuture`: a
+``Future``-style object whose ``result()`` *drives* the owning server until
+the request is complete (the in-process server is synchronous — there is no
+background thread to wait on, so waiting IS serving; see docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["EvalFuture", "EvalRequest"]
+
+
+class EvalRequest:
+    """One tenant's pending evaluation: an ``(n, P)`` parameter matrix, the
+    tenant's base PRNG key for this call, and the assembly buffers the
+    packer fills as items complete. Internal to the server; clients hold
+    the :class:`EvalFuture` (``request.future``)."""
+
+    def __init__(self, request_id: int, tenant, values, key, server):
+        self.request_id = int(request_id)
+        self.tenant = tenant
+        self.values = values  # (n, P), host or device
+        self.key = key  # typed PRNG key (scalar) — the tenant's base key
+        self.key_data = None  # raw key data (numpy), set by server.submit
+        self.num_solutions = int(values.shape[0])
+        # assembly state -----------------------------------------------------
+        self.next_item = 0  # first not-yet-packed solution index
+        self.pending_items = self.num_solutions  # packed-but-unfinished + unpacked
+        self.scores = np.full(self.num_solutions, np.nan, dtype=np.float64)
+        self.telemetry = None  # accumulated GroupTelemetry (tenant's row)
+        self.submit_dispatch = None  # server dispatch counter at submit time
+        self.future = EvalFuture(self, server)
+
+    @property
+    def done(self) -> bool:
+        return self.pending_items == 0
+
+    def take_items(self, k: int) -> range:
+        """Claim the next ``k`` (at most) unpacked solution indices."""
+        start = self.next_item
+        stop = min(start + int(k), self.num_solutions)
+        self.next_item = stop
+        return range(start, stop)
+
+
+class EvalFuture:
+    """Handle on a submitted evaluation.
+
+    ``done()`` is a cheap poll; ``result()`` drives the owning server's
+    dispatch loop until this request completes, then returns a
+    ``RolloutResult``-compatible record (scores / stats / counters /
+    telemetry wire) — what :class:`~evotorch_tpu.serving.RemoteEvalBackend`
+    hands back to an unmodified ``VecNE``. ``result()`` may therefore
+    execute device work for OTHER tenants too (their items share the
+    slabs); that is the point of the service.
+    """
+
+    def __init__(self, request: EvalRequest, server):
+        self._request = request
+        self._server = server
+        self._lock = threading.Lock()
+        self._result: Optional[Any] = None
+        self._error: Optional[BaseException] = None
+
+    @property
+    def request_id(self) -> int:
+        return self._request.request_id
+
+    @property
+    def tenant(self):
+        return self._request.tenant
+
+    def done(self) -> bool:
+        with self._lock:
+            return self._result is not None or self._error is not None
+
+    def set_result(self, result) -> None:
+        with self._lock:
+            self._result = result
+
+    def set_error(self, error: BaseException) -> None:
+        with self._lock:
+            self._error = error
+
+    def result(self, *, max_dispatches: Optional[int] = None):
+        """Drive the server until this request is complete; return the
+        evaluation record. ``max_dispatches`` bounds the number of device
+        dispatches this call may execute (None = until done)."""
+        dispatched = 0
+        while not self.done():
+            served = self._server.step()
+            dispatched += 1
+            if served == 0 and not self.done():
+                raise RuntimeError(
+                    f"request {self.request_id} cannot complete: the server"
+                    " has no pending work for it (was its tenant departed?)"
+                )
+            if max_dispatches is not None and dispatched >= max_dispatches:
+                break
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            if self._result is None:
+                raise TimeoutError(
+                    f"request {self.request_id} still pending after"
+                    f" {dispatched} dispatches"
+                )
+            return self._result

@@ -1,0 +1,574 @@
+"""The in-process multi-tenant evaluation server.
+
+One :class:`EvalServer` owns ONE resident compiled ``episodes_refill``
+program (``parallel.make_resident_rollout_program``) and keeps it saturated
+with (solution, episode) items from many concurrent searches — vLLM-style
+continuous batching where the telemetry group id IS the tenant id:
+
+- the slab (``slab_size`` parameter rows), the lane width, the group-row
+  count (``max_tenants + 1``) and the mesh layout are fixed at server
+  construction — the residency key;
+- everything per-dispatch — which tenant owns which slab row, each item's
+  tenant-local lane id, each tenant's base PRNG key, the stacked obs-norm
+  slots — is a TRACED program input, so tenants admitting, departing and
+  churning re-dispatch the same executable (steady_compiles == 0; the
+  serving tests pin it with the retrace sentinel);
+- group row 0 is RESERVED for padding: a partially-filled slab repeats a
+  real row's parameters into the idle lanes but charges their steps to
+  group 0, so no tenant's occupancy/score statistics ever see them.
+
+Isolation guarantees (docs/serving.md): per-tenant PRNG (each item's key
+chain derives from ITS request's base key via ``solution_keys``, exactly
+the standalone derivation — per-tenant scores are bit-identical to the
+tenant evaluating alone), per-tenant obs-norm slots (stacked
+``CollectedStats``; a slot resets on departure), per-tenant telemetry
+rows, and per-tenant SLO admission control (a tenant tripping its
+watchdog stops being able to submit, it does not take the server down).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .admission import AdmissionPolicy, resolve_policy
+from .requests import EvalFuture, EvalRequest
+
+__all__ = ["EvalServer", "Tenant"]
+
+
+class Tenant:
+    """One admitted search: a group row, a FIFO of pending requests, the
+    cumulative telemetry accounting and the SLO admission state."""
+
+    def __init__(self, group: int, name: str, admitted_dispatch: int, watchdog=None):
+        self.group = int(group)
+        self.name = str(name)
+        self.admitted_dispatch = int(admitted_dispatch)
+        self.watchdog = watchdog
+        self.pending: deque = deque()  # EvalRequests with unpacked/unfinished items
+        self.telemetry = None  # cumulative GroupTelemetry (this tenant's row)
+        self.suspended = False
+        self.slo_report = None
+        self.requests_served = 0
+
+    @property
+    def pending_items(self) -> int:
+        return sum(r.pending_items for r in self.pending)
+
+    def oldest_pending_dispatch(self) -> int:
+        """Submit-time dispatch index of the oldest pending request (the
+        FIFO admission key); large when nothing is pending."""
+        if not self.pending:
+            return 1 << 62
+        return self.pending[0].submit_dispatch
+
+    def __repr__(self):
+        state = "suspended" if self.suspended else "active"
+        return (
+            f"Tenant({self.name!r}, group={self.group}, {state},"
+            f" pending={self.pending_items})"
+        )
+
+
+class EvalServer:
+    """Long-running in-process evaluation service over one resident program.
+
+    Parameters
+    ----------
+    env : str or Env — the (shared) evaluation environment.
+    network : a net Module or FlatParamsPolicy — the (shared) policy form;
+        every tenant's solutions must be this policy's flat parameters.
+    slab_size : parameter rows per dispatch (the packing width).
+    max_tenants : group rows 1..max_tenants (row 0 is the padding group).
+    refill_width / refill_period : the refill engine's lane schedule
+        (width defaults to the engine's own default for the slab).
+    num_episodes / episode_length / observation_normalization /
+    compute_dtype : the eval contract, shared by all tenants (a tenant
+        needing a different contract needs a different server; residency
+        means ONE program).
+    admission : AdmissionPolicy | "fifo" | "starvation" | None — inter-tenant
+        packing order (docs/serving.md "Fairness").
+    slo : SLO rule list — each tenant gets its OWN stateful watchdog over
+        these rules; a violating tenant is suspended (submit refuses) while
+        its already-queued work drains.
+    metrics : a MetricsHub — per-dispatch rows with the per-tenant telemetry
+        breakdown.
+    mesh : optional device mesh; the slab is GSPMD-pinned to it inside the
+        resident program (scores stay bit-identical to unsharded).
+    nonfinite_penalty : enables non-finite score quarantine with a FIXED
+        penalty. The batch-worst-finite default is deliberately NOT offered
+        here: the "batch" is the whole multi-tenant slab, so the worst
+        finite score would leak across tenant boundaries.
+    seed : folds the per-dispatch engine key (unused for item randomness —
+        that comes from each request's base key — but still a program input).
+    """
+
+    def __init__(
+        self,
+        env,
+        network,
+        *,
+        slab_size: int,
+        max_tenants: int = 4,
+        refill_width: Optional[int] = None,
+        refill_period: int = 1,
+        num_episodes: int = 1,
+        episode_length: Optional[int] = None,
+        observation_normalization: bool = False,
+        compute_dtype=None,
+        admission=None,
+        slo=None,
+        metrics=None,
+        mesh=None,
+        health: bool = True,
+        nonfinite_penalty: Optional[float] = None,
+        seed: int = 0,
+        seed_stride: Optional[int] = None,
+    ):
+        import jax
+
+        from ..envs import Env, make_env
+        from ..neuroevolution.net.functional import FlatParamsPolicy
+        from ..neuroevolution.net.layers import Module
+        from ..parallel.evaluate import make_resident_rollout_program
+
+        if isinstance(env, str):
+            env = make_env(env)
+        if not isinstance(env, Env):
+            raise TypeError(f"env must be a string or Env, got {type(env).__name__}")
+        if isinstance(network, FlatParamsPolicy):
+            policy = network
+        elif isinstance(network, Module):
+            policy = FlatParamsPolicy(network)
+        else:
+            raise TypeError(
+                "network must be a net Module or FlatParamsPolicy,"
+                f" got {type(network).__name__}"
+            )
+        self.env = env
+        self.policy = policy
+        self.slab_size = int(slab_size)
+        if self.slab_size < 1:
+            raise ValueError(f"slab_size must be >= 1, got {slab_size}")
+        self.max_tenants = int(max_tenants)
+        if self.max_tenants < 1:
+            raise ValueError(f"max_tenants must be >= 1, got {max_tenants}")
+        self.num_groups = self.max_tenants + 1  # group 0 = padding
+        self.num_episodes = int(num_episodes)
+        self.episode_length = episode_length
+        self.observation_normalization = bool(observation_normalization)
+        self.compute_dtype = compute_dtype
+        # one static stride for the whole slab: at num_episodes == 1 the
+        # episode index is always 0 so it never enters the item seeds; at
+        # num_episodes > 1 a standalone run matches bit-for-bit when it
+        # passes seed_stride=server.seed_stride (docs/serving.md)
+        self.seed_stride = int(seed_stride) if seed_stride is not None else self.slab_size
+        self._admission: AdmissionPolicy = resolve_policy(admission)
+        self._slo_rules = slo
+        self._metrics = metrics
+        self._mesh = mesh
+
+        rollout_kwargs = dict(
+            num_episodes=self.num_episodes,
+            episode_length=self.episode_length,
+            observation_normalization=self.observation_normalization,
+            compute_dtype=compute_dtype,
+            num_groups=self.num_groups,
+            seed_stride=self.seed_stride,
+            refill_period=int(refill_period),
+            telemetry=True,  # the server's accounting plane — not optional
+            health=bool(health),
+        )
+        if refill_width is not None:
+            rollout_kwargs["refill_width"] = int(refill_width)
+        if nonfinite_penalty is not None:
+            rollout_kwargs["nonfinite_quarantine"] = True
+            rollout_kwargs["nonfinite_penalty"] = float(nonfinite_penalty)
+        self.program = make_resident_rollout_program(
+            env, policy, mesh=mesh, **rollout_kwargs
+        )
+
+        self._key = jax.random.key(int(seed))
+        if self.observation_normalization:
+            from ..neuroevolution.net.runningnorm import group_stats_init
+
+            self._stats = group_stats_init(self.num_groups, env.observation_size)
+        else:
+            self._stats = None
+        self._lock = threading.RLock()
+        self._tenants: Dict[int, Tenant] = {}  # group -> Tenant
+        self._by_name: Dict[str, Tenant] = {}
+        self._next_request_id = 0
+        self._dispatch_count = 0
+        self._items_served = 0
+        self._score_dtype = None
+
+    # ------------------------------------------------------ tenant lifecycle
+    def admit(self, name: Optional[str] = None) -> Tenant:
+        """Register a tenant; returns its handle. Raises when all
+        ``max_tenants`` group rows are occupied."""
+        with self._lock:
+            free = [g for g in range(1, self.num_groups) if g not in self._tenants]
+            if not free:
+                raise RuntimeError(
+                    f"server is full: {self.max_tenants} tenants admitted"
+                )
+            group = free[0]
+            if name is None:
+                name = f"tenant{group}"
+            if name in self._by_name:
+                raise ValueError(f"tenant name {name!r} already admitted")
+            watchdog = None
+            if self._slo_rules is not None:
+                from ..observability.slo import SLOWatchdog
+
+                # each tenant gets its OWN stateful watchdog so the health
+                # trend windows never mix across tenants
+                watchdog = SLOWatchdog(self._slo_rules)
+            tenant = Tenant(group, name, self._dispatch_count, watchdog)
+            self._tenants[group] = tenant
+            self._by_name[name] = tenant
+            return tenant
+
+    def depart(self, tenant: Tenant, *, cancel: bool = False) -> None:
+        """Release a tenant's group row. Pending requests either forbid the
+        departure (default) or are cancelled (their futures raise). The
+        tenant's obs-norm slot is zeroed, so the row is clean for the next
+        admission — lane rebinding on churn, no retrace (the slot is a
+        traced input)."""
+        with self._lock:
+            if self._tenants.get(tenant.group) is not tenant:
+                raise ValueError(f"{tenant!r} is not admitted on this server")
+            if tenant.pending and not cancel:
+                raise RuntimeError(
+                    f"{tenant!r} has pending work; drain it or depart(cancel=True)"
+                )
+            for req in tenant.pending:
+                req.future.set_error(
+                    RuntimeError(
+                        f"request {req.request_id} cancelled: tenant"
+                        f" {tenant.name!r} departed"
+                    )
+                )
+            tenant.pending.clear()
+            if self._stats is not None:
+                from ..neuroevolution.net.runningnorm import CollectedStats
+
+                g = tenant.group
+                self._stats = CollectedStats(
+                    count=self._stats.count.at[g].set(0.0),
+                    sum=self._stats.sum.at[g].set(0.0),
+                    sum_of_squares=self._stats.sum_of_squares.at[g].set(0.0),
+                )
+            del self._tenants[tenant.group]
+            del self._by_name[tenant.name]
+
+    @property
+    def tenants(self) -> Tuple[Tenant, ...]:
+        with self._lock:
+            return tuple(self._tenants[g] for g in sorted(self._tenants))
+
+    # -------------------------------------------------------------- obs-norm
+    def tenant_stats(self, tenant: Tenant):
+        """The tenant's current obs-norm slot as a plain CollectedStats
+        (None when the server runs without observation normalization)."""
+        if self._stats is None:
+            return None
+        from ..neuroevolution.net.runningnorm import stats_slot
+
+        return stats_slot(self._stats, tenant.group)
+
+    def _seed_tenant_stats(self, tenant: Tenant, stats) -> None:
+        """Overwrite the tenant's slot from a submitted (unstacked) stats
+        pytree — how a resuming search re-seeds its normalization history."""
+        from ..neuroevolution.net.runningnorm import CollectedStats
+
+        g = tenant.group
+        self._stats = CollectedStats(
+            count=self._stats.count.at[g].set(stats.count),
+            sum=self._stats.sum.at[g].set(stats.sum),
+            sum_of_squares=self._stats.sum_of_squares.at[g].set(stats.sum_of_squares),
+        )
+
+    # ------------------------------------------------------------- submission
+    def submit(self, tenant: Tenant, values, key=None, *, stats=None) -> EvalFuture:
+        """Queue one evaluation of an ``(n, P)`` parameter matrix under the
+        tenant's identity; returns the :class:`EvalFuture`.
+
+        ``key`` is the request's base PRNG key (typed or legacy uint32);
+        item ``i`` of the request evaluates with exactly the randomness a
+        standalone ``episodes_refill`` run over the same matrix and key
+        would give it, whatever the packing. Defaults to a key folded from
+        the server seed and the request id (reproducible, but NOT any
+        standalone run's key — pass the search's own key for bit-identity).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._tenants.get(tenant.group) is not tenant:
+                raise ValueError(f"{tenant!r} is not admitted on this server")
+            if tenant.suspended:
+                raise RuntimeError(
+                    f"tenant {tenant.name!r} is suspended by its SLO watchdog"
+                    f" ({tenant.slo_report.summary() if tenant.slo_report else 'no report'})"
+                )
+            values = np.asarray(values, dtype=np.float32)
+            if values.ndim != 2 or values.shape[1] != self.policy.parameter_count:
+                raise ValueError(
+                    f"values must be (n, {self.policy.parameter_count}),"
+                    f" got {values.shape}"
+                )
+            if key is None:
+                key = jax.random.fold_in(self._key, self._next_request_id)
+            else:
+                key = jnp.asarray(key)
+                if not jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+                    key = jax.random.wrap_key_data(key)
+            if stats is not None:
+                if self._stats is None:
+                    raise ValueError(
+                        "stats submitted but the server runs without"
+                        " observation normalization"
+                    )
+                self._seed_tenant_stats(tenant, stats)
+            request = EvalRequest(self._next_request_id, tenant, values, key, self)
+            # the key's raw data, snapshotted ONCE: the packer broadcasts it
+            # into the slab's key rows host-side (no per-dispatch device
+            # sync, no per-item key stack)
+            request.key_data = np.asarray(jax.random.key_data(key))
+            request.submit_dispatch = self._dispatch_count
+            self._next_request_id += 1
+            tenant.pending.append(request)
+            return request.future
+
+    # --------------------------------------------------------------- serving
+    def step(self) -> int:
+        """Pack ONE slab from the pending queues and dispatch it; returns
+        the number of real (non-padding) items served (0 = nothing
+        pending, no dispatch)."""
+        import jax
+
+        with self._lock:
+            runs = self._pack()
+            if not runs:
+                return 0
+            n_packed = sum(count for _, _, count in runs)
+            slab, lane_ids, groups, solution_keys = self._slab_arrays(
+                runs, n_packed
+            )
+            out = self.program(
+                slab,
+                jax.random.fold_in(self._key, self._dispatch_count),
+                self._stats,
+                lane_ids,
+                groups,
+                solution_keys,
+            )
+            self._dispatch_count += 1
+            self._items_served += n_packed
+            if self._stats is not None:
+                self._stats = out.stats
+            self._credit(runs, out)
+            return n_packed
+
+    def drain(self) -> int:
+        """Serve until every queue is empty; returns dispatches executed."""
+        dispatches = 0
+        while self.step():
+            dispatches += 1
+        return dispatches
+
+    def _pack(self) -> List[Tuple[EvalRequest, int, int]]:
+        """The packing round: walk tenants in the admission policy's order,
+        taking each tenant's queued items FIFO, until the slab is full or
+        nothing is pending. Suspended tenants still DRAIN (suspension
+        gates new submits, not queued work — no deadlocked futures).
+        Returns contiguous runs ``(request, first_item, count)`` so the
+        slab materializes with slice copies, not a per-row host loop."""
+        ready = [t for t in self._tenants.values() if t.pending]
+        if not ready:
+            return []
+        runs: List[Tuple[EvalRequest, int, int]] = []
+        n_packed = 0
+        for tenant in self._admission.order(ready, self):
+            for request in tenant.pending:
+                room = self.slab_size - n_packed
+                if room <= 0:
+                    break
+                taken = request.take_items(room)
+                if len(taken):
+                    runs.append((request, taken.start, len(taken)))
+                    n_packed += len(taken)
+            if n_packed >= self.slab_size:
+                break
+        return runs
+
+    def _slab_arrays(self, runs, n_packed: int):
+        """Materialize one dispatch's traced inputs. Idle rows repeat the
+        first packed row (same compute shape, so the program never sees a
+        ragged slab) but bind to group 0 — the reserved padding group no
+        tenant reads. Everything is built with per-run slice copies on the
+        host (numpy in, per the dispatch-cost note in CLAUDE.md) — one
+        ``wrap_key_data`` upload replaces a per-item key stack."""
+        import jax
+
+        slab = np.empty((self.slab_size, self.policy.parameter_count), dtype=np.float32)
+        lane_ids = np.empty(self.slab_size, dtype=np.int32)
+        groups = np.empty(self.slab_size, dtype=np.int32)
+        key_rows = np.empty(
+            (self.slab_size,) + runs[0][0].key_data.shape, dtype=runs[0][0].key_data.dtype
+        )
+        row = 0
+        for request, start, count in runs:
+            stop = row + count
+            slab[row:stop] = request.values[start : start + count]
+            # request-local item indices: the standalone seed identity
+            lane_ids[row:stop] = np.arange(start, start + count, dtype=np.int32)
+            groups[row:stop] = request.tenant.group
+            key_rows[row:stop] = request.key_data
+            row = stop
+        if row < self.slab_size:
+            slab[row:] = slab[0]
+            lane_ids[row:] = lane_ids[0]
+            groups[row:] = 0
+            key_rows[row:] = key_rows[0]
+        return slab, lane_ids, groups, jax.random.wrap_key_data(key_rows)
+
+    def _credit(self, runs, out) -> None:
+        """Distribute one dispatch's results: per-item scores into their
+        requests, the per-group telemetry rows into tenant/request
+        accounting, SLO verdicts into admission state, completed requests
+        into their futures."""
+        from ..observability.devicemetrics import GroupTelemetry
+
+        scores = np.asarray(out.scores)
+        if self._score_dtype is None:
+            self._score_dtype = scores.dtype
+        gt = GroupTelemetry.from_array(np.asarray(out.telemetry))
+
+        touched_requests: List[EvalRequest] = []
+        touched_tenants: List[Tenant] = []
+        row0 = 0
+        for request, start, count in runs:
+            request.scores[start : start + count] = scores[row0 : row0 + count]
+            request.pending_items -= count
+            row0 += count
+            if not touched_requests or touched_requests[-1] is not request:
+                touched_requests.append(request)
+            tenant = request.tenant
+            if not touched_tenants or touched_tenants[-1] is not tenant:
+                touched_tenants.append(tenant)
+
+        row_cache: Dict[int, GroupTelemetry] = {}
+
+        def tenant_row(g: int) -> GroupTelemetry:
+            if g not in row_cache:
+                row_cache[g] = GroupTelemetry(
+                    data=gt.data[g : g + 1].copy(),
+                    health=None if gt.health is None else gt.health[g : g + 1].copy(),
+                )
+            return row_cache[g]
+
+        for tenant in touched_tenants:
+            row = tenant_row(tenant.group)
+            tenant.telemetry = row if tenant.telemetry is None else tenant.telemetry + row
+            if tenant.watchdog is not None:
+                report = tenant.watchdog.check(tenant.telemetry)
+                tenant.slo_report = report
+                if not report.ok:
+                    tenant.suspended = True
+        for request in touched_requests:
+            # a tenant's dispatch row covers ALL its lanes this dispatch;
+            # when a tenant runs requests concurrently, each touched request
+            # accrues the shared row (per-request figures are then an
+            # over-count; per-TENANT figures stay exact — docs/serving.md)
+            row = tenant_row(request.tenant.group)
+            request.telemetry = (
+                row if request.telemetry is None else request.telemetry + row
+            )
+            if request.done:
+                request.tenant.pending.remove(request)
+                request.tenant.requests_served += 1
+                self._finish(request)
+        if self._metrics is not None:
+            self._metrics.emit(
+                {
+                    "dispatch": self._dispatch_count - 1,
+                    "served": row0,
+                    "slab": self.slab_size,
+                    "tenants": {
+                        t.name: t.group for t in self._tenants.values()
+                    },
+                },
+                telemetry=gt,
+            )
+
+    def _finish(self, request: EvalRequest) -> None:
+        """Assemble a completed request's RolloutResult-compatible record."""
+        import jax.numpy as jnp
+
+        from ..neuroevolution.net.vecrl import RolloutResult
+
+        total = request.telemetry.total()
+        result = RolloutResult(
+            scores=jnp.asarray(request.scores.astype(self._score_dtype)),
+            stats=self.tenant_stats(request.tenant),
+            total_steps=total.env_steps,
+            total_episodes=total.episodes,
+            telemetry=request.telemetry.to_wire(),
+        )
+        request.future.set_result(result)
+
+    # ------------------------------------------------------------- inspection
+    @property
+    def dispatches(self) -> int:
+        return self._dispatch_count
+
+    @property
+    def items_served(self) -> int:
+        return self._items_served
+
+    def occupancy(self) -> float:
+        """Share of dispatched slab rows carrying real tenant items (the
+        rest were group-0 padding), cumulative over the server's life;
+        0.0 before the first dispatch."""
+        total_rows = self._dispatch_count * self.slab_size
+        if total_rows == 0:
+            return 0.0
+        return self._items_served / total_rows
+
+    def status(self) -> dict:
+        """JSON-safe service summary (the stdio front's ``status`` op)."""
+        with self._lock:
+            tenants = {}
+            for t in self._tenants.values():
+                entry = {
+                    "group": t.group,
+                    "suspended": bool(t.suspended),
+                    "pending_items": t.pending_items,
+                    "requests_served": t.requests_served,
+                }
+                if t.telemetry is not None:
+                    entry["queue_wait_p50"] = t.telemetry.queue_wait_quantile(0.5)
+                    entry["queue_wait_p99"] = t.telemetry.queue_wait_quantile(0.99)
+                    entry["starvation_share"] = round(t.telemetry.starvation_share(), 6)
+                    entry["env_steps"] = t.telemetry.total().env_steps
+                    entry["episodes"] = t.telemetry.total().episodes
+                if t.slo_report is not None:
+                    entry.update(t.slo_report.as_status())
+                tenants[t.name] = entry
+            return {
+                "slab_size": self.slab_size,
+                "max_tenants": self.max_tenants,
+                "dispatches": self._dispatch_count,
+                "items_served": self._items_served,
+                "occupancy": round(self.occupancy(), 6),
+                "admission": repr(self._admission),
+                "program_key": list(self.program.key),
+                "tenants": tenants,
+            }

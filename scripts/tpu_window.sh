@@ -73,9 +73,14 @@ run bench_bf16 1800 env BENCH_BF16=1 python bench.py
 # generation means the eval distribution degenerated (the score-side
 # stdev-collapse signal), loose enough that a healthy flagship line never
 # trips it
+# --max-queue-wait-p99 is likewise loose: the refill queue-wait histogram
+# tops out at its 64-step overflow bucket, so 1e6 only trips if the wire
+# itself is corrupt — it pins the flag's plumbing on real hardware without
+# betting the verdict on an untuned tail (docs/serving.md "SLOs")
 run slo_check 300 python -m evotorch_tpu.observability.slo \
   --check-bench "$OUT/bench_f32.log" --min-model-efficiency 1e-5 \
   --max-score-collapse 1e6 \
+  --max-queue-wait-p99 1e6 \
   --verdict-out "$OUT/slo_verdict.txt"
 
 # 2. the MXU claim: wide policy dense vs low-rank (budget contract isolates
@@ -127,6 +132,14 @@ run autotune_span 2400 env BENCH_BF16=1 \
 #     donated GSPMD program vs the same body dispatched per generation from
 #     the host (span_speedup on the JSON line; steady_compiles must be 0)
 run span_bench 2400 env BENCH_BF16=1 BENCH_SPAN=auto python bench.py
+
+# 3f. multi-tenant serving A/B at the flagship shape: 4 concurrent tenants
+#     packed through ONE EvalServer's resident episodes_refill program vs
+#     the same searches dispatched sequentially standalone (serve_speedup /
+#     serve_occupancy / per-tenant queue-wait quantiles on the JSON line;
+#     per-tenant scores asserted bit-identical to the standalone leg before
+#     the clock starts — docs/serving.md)
+run serve_bench 2400 env BENCH_BF16=1 BENCH_SERVE=1 python bench.py
 
 # 4. sharded bench on the single real chip (mesh of 1; exercise the path)
 run bench_multichip 1800 python bench_multichip.py
